@@ -1,0 +1,28 @@
+"""apex_tpu.amp: mixed-precision training.
+
+TPU-native re-design of ``apex/amp``: O0-O3 opt-level presets, a dynamic loss
+scaler with hysteresis, an O1 per-op autocast (scoped function patching during
+trace), and an O2 master-weight path integrated with the fused optimizers.
+See ``apex_tpu/amp/frontend.py`` for the ``initialize()`` entry point.
+"""
+from .amp import (  # noqa: F401
+    autocast,
+    disable_casts,
+    register_half_function,
+    register_bf16_function,
+    register_float_function,
+    register_promote_function,
+)
+from .scaler import LossScaler, LossScaleState  # noqa: F401
+from .handle import (  # noqa: F401
+    scale_loss,
+    scaled_value_and_grad,
+    apply_updates_skip_on_overflow,
+)
+from .frontend import (  # noqa: F401
+    Properties,
+    initialize,
+    opt_levels,
+    state_dict,
+    load_state_dict,
+)

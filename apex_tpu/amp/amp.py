@@ -1,0 +1,126 @@
+"""O1 autocast: registry-driven per-op casting, JAX edition.
+
+Reference: ``apex/amp/amp.py:74`` + ``apex/amp/wrap.py`` — torch namespaces are
+monkey-patched once at ``amp.init()`` and stay patched. In JAX, tracing runs
+eagerly in Python, so the same mechanism works *scoped*: ``autocast()`` patches
+the registered jnp/lax/jax.nn functions for the duration of a trace and
+restores them on exit. Everything the wrapped ops record into the jaxpr carries
+the casts; outside the context nothing is touched. This gives O1 semantics
+(per-op allow/deny lists, cast cache) with no global state and full jit
+compatibility.
+
+Example::
+
+    with amp.autocast(dtype=jnp.bfloat16):
+        y = model_apply(params, x)   # matmuls in bf16, softmax/log in fp32
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Callable, List, Tuple
+
+import jax.numpy as jnp
+
+from . import utils
+from .lists import jax_overrides
+
+_EXTRA_LOW_PRECISION: List[Tuple[object, str]] = []
+_EXTRA_FP32: List[Tuple[object, str]] = []
+_local = threading.local()
+
+
+def register_half_function(module, name: str) -> None:
+    """Add (module, name) to the low-precision list (``apex/amp/amp.py`` parity)."""
+    _EXTRA_LOW_PRECISION.append((module, name))
+
+
+register_bf16_function = register_half_function
+
+
+def register_float_function(module, name: str) -> None:
+    _EXTRA_FP32.append((module, name))
+
+
+def register_promote_function(module, name: str) -> None:
+    # JAX promotes mixed dtypes natively; nothing to patch.
+    pass
+
+
+def _wrap(orig: Callable, cast_fn, cache) -> Callable:
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        depth = getattr(_local, "depth", 0)
+        if depth:
+            # ops called from inside another wrapped op keep their dtypes
+            return orig(*args, **kwargs)
+        _local.depth = 1
+        try:
+            new_args, new_kwargs = utils.casted_args(cast_fn, args, kwargs, cache)
+            return orig(*new_args, **new_kwargs)
+        finally:
+            _local.depth = 0
+
+    wrapper.__apex_tpu_wrapped__ = orig
+    return wrapper
+
+
+@contextlib.contextmanager
+def autocast(enabled: bool = True, dtype=jnp.bfloat16, cache_casts: bool = True):
+    """Scoped O1 patching of the registered function lists.
+
+    ``dtype`` is the low-precision compute type (bf16 on TPU; fp16 accepted
+    for parity). ``cache_casts`` mirrors the reference's fp16 cast cache
+    (``apex/amp/utils.py:90``).
+    """
+    if not enabled:
+        yield
+        return
+
+    cache: dict = {} if cache_casts else None
+    low = functools.partial(utils.maybe_low_precision, dtype=dtype)
+    saved = []
+    try:
+        for module, name in list(jax_overrides.LOW_PRECISION_FUNCS) + _EXTRA_LOW_PRECISION:
+            orig = getattr(module, name)
+            if getattr(orig, "__apex_tpu_wrapped__", None) is not None:
+                continue
+            saved.append((module, name, orig))
+            setattr(module, name, _wrap(orig, low, cache))
+        for module, name in list(jax_overrides.FP32_FUNCS) + _EXTRA_FP32:
+            orig = getattr(module, name)
+            if getattr(orig, "__apex_tpu_wrapped__", None) is not None:
+                continue
+            saved.append((module, name, orig))
+            setattr(module, name, _wrap(orig, utils.maybe_float, cache))
+        yield
+    finally:
+        for module, name, orig in reversed(saved):
+            setattr(module, name, orig)
+        if cache is not None:
+            cache.clear()
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Escape hatch mirroring ``apex.amp.handle.disable_casts``: restores the
+    original functions inside an ``autocast`` region."""
+    restored = []
+    for lst in (
+        jax_overrides.LOW_PRECISION_FUNCS,
+        jax_overrides.FP32_FUNCS,
+        _EXTRA_LOW_PRECISION,
+        _EXTRA_FP32,
+    ):
+        for module, name in lst:
+            cur = getattr(module, name)
+            orig = getattr(cur, "__apex_tpu_wrapped__", None)
+            if orig is not None:
+                restored.append((module, name, cur))
+                setattr(module, name, orig)
+    try:
+        yield
+    finally:
+        for module, name, wrapped in restored:
+            setattr(module, name, wrapped)

@@ -1,0 +1,248 @@
+"""amp.initialize and the O0-O3 opt-level presets.
+
+Reference: ``apex/amp/frontend.py`` — ``Properties`` (``:9``), the four
+``O0``-``O3`` preset objects (``:104-193``), ``initialize`` (``:197-362``) and
+scaler checkpointing (``:365-404``).
+
+Functional divergence (documented, deliberate): torch amp mutates the model
+and optimizer in place and hides scaler state in a global; in JAX everything
+is explicit, so ``initialize`` returns ``(params, optimizers, amp_state)``:
+
+- ``params``: cast per the opt level (bf16/fp16 for O2/O3, with
+  batchnorm-like leaves kept fp32 when ``keep_batchnorm_fp32`` — the
+  ``convert_network`` behaviour of ``apex/amp/_initialize.py:179-181``),
+- ``optimizers``: the same objects, flipped to ``master_weights`` mode when
+  the preset demands it (the ``_process_optimizer`` O2 machinery collapses to
+  the fused optimizers' built-in fp32 master path),
+- ``amp_state``: opt properties + one ``LossScaler`` and state per loss
+  (``num_losses``, reference ``_initialize.py:229-233``) + the O1 autocast
+  context.
+
+Typical use::
+
+    params, opt, amp_state = amp.initialize(params, opt, opt_level="O2")
+    fn = amp.scaled_value_and_grad(loss_fn, amp_state.scaler(0))
+    (loss, grads, sstate) = fn(amp_state.scaler_state(0), params, batch)
+    new_params, opt_state = opt.step(grads, opt_state, params,
+                                     found_inf=sstate.found_inf)
+    amp_state = amp_state.with_scaler_state(0, amp_state.scaler(0).update_scale(sstate))
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import amp as _amp_mod
+from .scaler import LossScaler, LossScaleState
+
+Pytree = Any
+
+_BN_MARKERS = ("batchnorm", "batch_norm", "bn", "norm_stats")
+
+
+@dataclasses.dataclass
+class Properties:
+    """Mutable opt-level property bag (``apex/amp/frontend.py:9-101``)."""
+
+    enabled: bool = True
+    opt_level: Optional[str] = None
+    cast_model_type: Optional[Any] = None  # jnp dtype or None
+    patch_functions: bool = False  # O1 autocast ("patch_torch_functions")
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Any = 1.0  # float or "dynamic"
+
+
+def _o0(half_dtype):
+    return Properties(
+        opt_level="O0",
+        cast_model_type=jnp.float32,
+        patch_functions=False,
+        keep_batchnorm_fp32=False,
+        master_weights=False,
+        loss_scale=1.0,
+    )
+
+
+def _o1(half_dtype):
+    return Properties(
+        opt_level="O1",
+        cast_model_type=None,
+        patch_functions=True,
+        keep_batchnorm_fp32=None,
+        master_weights=None,
+        loss_scale="dynamic",
+    )
+
+
+def _o2(half_dtype):
+    return Properties(
+        opt_level="O2",
+        cast_model_type=half_dtype,
+        patch_functions=False,
+        keep_batchnorm_fp32=True,
+        master_weights=True,
+        loss_scale="dynamic",
+    )
+
+
+def _o3(half_dtype):
+    return Properties(
+        opt_level="O3",
+        cast_model_type=half_dtype,
+        patch_functions=False,
+        keep_batchnorm_fp32=False,
+        master_weights=False,
+        loss_scale=1.0,
+    )
+
+
+opt_levels = {"O0": _o0, "O1": _o1, "O2": _o2, "O3": _o3}
+
+
+def _is_bn_path(path) -> bool:
+    s = jax.tree_util.keystr(path).lower()
+    return any(m in s for m in _BN_MARKERS)
+
+
+def cast_model(params: Pytree, dtype, keep_batchnorm_fp32: bool) -> Pytree:
+    """Cast float params to ``dtype``; optionally keep batchnorm-ish leaves fp32.
+
+    The batchnorm test is a key-path heuristic (flax/haiku module names),
+    standing in for the reference's module-class walk
+    (``apex/fp16_utils/fp16util.py`` ``convert_network``).
+    """
+
+    def leaf(path, x):
+        if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return x
+        if keep_batchnorm_fp32 and _is_bn_path(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+class AmpState:
+    """Explicit replacement for the reference's ``_amp_state`` global."""
+
+    def __init__(self, properties: Properties, scalers: List[LossScaler], states: List[LossScaleState], half_dtype):
+        self._properties = properties
+        self._scalers = scalers
+        self._states = list(states)
+        self.half_dtype = half_dtype
+
+    @property
+    def opt_properties(self) -> Properties:
+        return self._properties
+
+    def scaler(self, loss_id: int = 0) -> LossScaler:
+        return self._scalers[loss_id]
+
+    def scaler_state(self, loss_id: int = 0) -> LossScaleState:
+        return self._states[loss_id]
+
+    def with_scaler_state(self, loss_id: int, state: LossScaleState) -> "AmpState":
+        new = AmpState(self._properties, self._scalers, list(self._states), self.half_dtype)
+        new._states[loss_id] = state
+        return new
+
+    def autocast(self):
+        """O1 context: per-op cast lists active during trace."""
+        return _amp_mod.autocast(
+            enabled=self._properties.patch_functions, dtype=self.half_dtype
+        )
+
+    # ``apex/amp/frontend.py:365-404`` parity
+    def state_dict(self) -> dict:
+        return {
+            f"loss_scaler{i}": s.state_dict(st)
+            for i, (s, st) in enumerate(zip(self._scalers, self._states))
+        }
+
+    def load_state_dict(self, sd: dict) -> "AmpState":
+        new_states = [
+            s.load_state_dict(sd[f"loss_scaler{i}"]) for i, s in enumerate(self._scalers)
+        ]
+        return AmpState(self._properties, self._scalers, new_states, self.half_dtype)
+
+
+def initialize(
+    models: Pytree,
+    optimizers=None,
+    opt_level: str = "O1",
+    cast_model_type=None,
+    patch_functions: Optional[bool] = None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale=None,
+    num_losses: int = 1,
+    half_dtype=jnp.bfloat16,
+    verbosity: int = 1,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+) -> Tuple[Pytree, Any, AmpState]:
+    """``amp.initialize`` (``apex/amp/frontend.py:197-362``), functional.
+
+    ``models`` is a param pytree (or list of them); ``optimizers`` a
+    ``FusedOptimizer`` (or list). Explicit kwargs override the preset, exactly
+    like the reference's Properties mutation.
+    """
+    if opt_level not in opt_levels:
+        raise RuntimeError(f"Unexpected optimization level {opt_level}")
+    props = opt_levels[opt_level](half_dtype)
+    if cast_model_type is not None:
+        props.cast_model_type = cast_model_type
+    if patch_functions is not None:
+        props.patch_functions = patch_functions
+    if keep_batchnorm_fp32 is not None:
+        props.keep_batchnorm_fp32 = keep_batchnorm_fp32
+    if master_weights is not None:
+        props.master_weights = master_weights
+    if loss_scale is not None:
+        props.loss_scale = loss_scale
+
+    models_list = models if isinstance(models, list) else [models]
+    if props.cast_model_type is not None:
+        models_list = [
+            cast_model(m, props.cast_model_type, bool(props.keep_batchnorm_fp32))
+            for m in models_list
+        ]
+
+    opts = optimizers if isinstance(optimizers, (list, tuple)) else (
+        [optimizers] if optimizers is not None else []
+    )
+    if props.master_weights:
+        for o in opts:
+            if hasattr(o, "master_weights"):
+                o.master_weights = True
+
+    scalers = [
+        LossScaler(
+            loss_scale=props.loss_scale,
+            min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale,
+        )
+        for _ in range(num_losses)
+    ]
+    states = [s.init_state() for s in scalers]
+    amp_state = AmpState(props, scalers, states, half_dtype)
+
+    out_models = models_list if isinstance(models, list) else models_list[0]
+    out_opts = (
+        optimizers
+        if isinstance(optimizers, (list, tuple)) or optimizers is None
+        else opts[0]
+    )
+    return out_models, out_opts, amp_state
+
+
+def state_dict(amp_state: AmpState) -> dict:
+    return amp_state.state_dict()
+
+
+def load_state_dict(amp_state: AmpState, sd: dict) -> AmpState:
+    return amp_state.load_state_dict(sd)

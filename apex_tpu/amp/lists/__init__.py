@@ -1,0 +1,1 @@
+from . import jax_overrides  # noqa: F401

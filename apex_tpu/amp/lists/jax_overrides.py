@@ -1,0 +1,82 @@
+"""Per-op autocast lists for the O1 policy.
+
+TPU-native analogue of ``apex/amp/lists/{torch,functional,tensor}_overrides.py``.
+The categories keep the reference's *intent* (what runs in low precision vs
+what must stay fp32), re-mapped onto the JAX namespaces where those ops
+actually live:
+
+- ``LOW_PRECISION_FUNCS`` — MXU-bound ops (matmul/conv family): run in
+  bf16/fp16. Mirrors the reference FP16 lists (conv*, matmul/mm/mv/linear).
+- ``FP32_FUNCS`` — numerically sensitive pointwise/reduction ops (exp/log/pow,
+  softmax family, norms, losses): inputs are upcast to fp32. Mirrors the
+  reference FP32 lists.
+- ``PROMOTE`` — mixed-dtype binary ops. In torch these need explicit widest-
+  type promotion wrappers; JAX's numpy-style dtype promotion already does
+  this (bf16 op fp32 -> fp32), so the list exists only for documentation and
+  for ``register_promote_function`` API parity.
+
+Entries are (module, attribute-name) pairs; the modules are patched in place
+for the duration of an ``autocast`` trace (see ``apex_tpu/amp/amp.py``).
+"""
+import jax
+import jax.nn
+import jax.numpy as jnp
+from jax import lax
+
+# (module, name) pairs. Names must exist on the module; checked at patch time.
+LOW_PRECISION_FUNCS = [
+    (jnp, "matmul"),
+    (jnp, "dot"),
+    (jnp, "vdot"),
+    (jnp, "inner"),
+    (jnp, "outer"),
+    (jnp, "tensordot"),
+    (jnp, "einsum"),
+    (lax, "dot"),
+    (lax, "dot_general"),
+    (lax, "conv"),
+    (lax, "conv_general_dilated"),
+    (lax, "conv_with_general_padding"),
+    (lax, "conv_transpose"),
+]
+
+FP32_FUNCS = [
+    # pointwise transcendentals (reference torch_overrides FP32_FUNCS)
+    (jnp, "exp"),
+    (jnp, "expm1"),
+    (jnp, "log"),
+    (jnp, "log10"),
+    (jnp, "log2"),
+    (jnp, "log1p"),
+    (jnp, "reciprocal"),
+    (jnp, "sinh"),
+    (jnp, "cosh"),
+    (jnp, "tan"),
+    (jnp, "arccos"),
+    (jnp, "arcsin"),
+    (jnp, "power"),
+    (jnp, "float_power"),
+    # reductions
+    (jnp, "cumsum"),
+    (jnp, "cumprod"),
+    (jnp, "sum"),
+    (jnp, "prod"),
+    (jnp, "std"),
+    (jnp, "var"),
+    (jnp.linalg, "norm"),
+    # softmax family + norm-ish activations (reference functional_overrides)
+    (jax.nn, "softmax"),
+    (jax.nn, "log_softmax"),
+    (jax.nn, "softplus"),
+    (jax.nn, "gelu"),
+    (jax.nn, "standardize"),
+    (jax.nn, "logsumexp"),
+]
+
+# JAX promotes mixed dtypes natively; kept for API parity only.
+PROMOTE_FUNCS = []
+
+# reference functional_overrides.BANNED_FUNCS: ops that silently break under
+# low precision. jax.nn has no binary_cross_entropy; sigmoid+BCE fusions are
+# the user's responsibility, so the list is empty by default.
+BANNED_FUNCS = []

@@ -1,0 +1,156 @@
+"""Dynamic / static loss scaling, functional-state edition.
+
+Reference: ``apex/amp/scaler.py:33-217`` (``LossScaler``) and
+``csrc/update_scale_hysteresis.cu``. The CUDA implementation mutates device
+buffers and does one D2H readback per step (``update_scale`` ``scaler.py:197``);
+here the scaler is a pure state machine — a ``LossScaleState`` pytree carried
+through the jitted train step — and overflow handling is a ``lax.cond`` (no
+host sync at all). Skip-step composes with any optimizer via
+``apex_tpu.amp.handle.scale_loss`` / the O2 frontend.
+
+bf16 on TPU does not need loss scaling (same exponent range as fp32); the
+scaler exists for fp16 parity and for API compatibility, and ``loss_scale=1.0``
+static mode makes it a no-op XLA removes entirely.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_scale,
+    update_scale_hysteresis,
+)
+
+Pytree = Any
+
+
+class LossScaleState(NamedTuple):
+    """Carried scaler state (all device scalars, jit-friendly).
+
+    ``unskipped`` mirrors ``apex/amp/scaler.py``'s growth counter; the
+    hysteresis tracker mirrors ``update_scale_hysteresis.cu``.
+    """
+
+    loss_scale: jax.Array  # f32 scalar
+    unskipped: jax.Array  # i32 scalar, clean steps since last scale change
+    hysteresis: jax.Array  # i32 scalar, overflow allowance remaining
+    found_inf: jax.Array  # bool scalar, overflow seen in the current step
+
+
+class LossScaler:
+    """Static or dynamic loss scaler.
+
+    Parameters mirror ``apex/amp/scaler.py:33-60``: ``loss_scale`` is either a
+    float (static) or ``"dynamic"``; dynamic scaling starts at ``init_scale``
+    (2**16), grows by ``scale_factor`` (2) every ``scale_window`` (2000) clean
+    steps, backs off by ``1/scale_factor`` on overflow, clamped to
+    ``[min_loss_scale, max_loss_scale]`` (max default 2**24,
+    ``apex/amp/scaler.py:42``). ``hysteresis`` extends the reference with the
+    fork's ``update_scale_hysteresis`` tolerance for repeated infs (default 1
+    == classic behaviour).
+    """
+
+    def __init__(
+        self,
+        loss_scale: float | str = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+        hysteresis: int = 1,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        self._init_scale = float(init_scale) if self.dynamic else float(loss_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = float(max_loss_scale)
+        self.hysteresis = int(hysteresis)
+
+    # -- state ------------------------------------------------------------
+    def init_state(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.float32(self._init_scale),
+            unskipped=jnp.int32(0),
+            hysteresis=jnp.int32(self.hysteresis),
+            found_inf=jnp.asarray(False),
+        )
+
+    # -- step-time ops (pure, jittable) ------------------------------------
+    def scale_loss(self, state: LossScaleState, loss: jax.Array) -> jax.Array:
+        """loss * scale (``apex/amp/handle.py:107-113``)."""
+        return loss * state.loss_scale.astype(loss.dtype)
+
+    def unscale(
+        self, state: LossScaleState, grads: Pytree, out_dtype=None
+    ) -> Tuple[Pytree, LossScaleState]:
+        """Unscale grads by 1/scale, recording overflow.
+
+        Reference ``apex/amp/scaler.py:94-150`` (``unscale`` via
+        ``multi_tensor_scale`` with inf screening).
+        """
+        inv = 1.0 / state.loss_scale
+        out, found = multi_tensor_scale(grads, inv, out_dtype=out_dtype)
+        return out, state._replace(found_inf=state.found_inf | found)
+
+    def unscale_with_stashed(
+        self, state: LossScaleState, new_scaled_grads: Pytree, stashed_grads: Pytree
+    ) -> Tuple[Pytree, LossScaleState]:
+        """out = new/scale + stashed — gradient accumulation across backwards.
+
+        Reference ``apex/amp/scaler.py:152-196`` (``unscale_with_stashed`` via
+        ``multi_tensor_axpby``).
+        """
+        inv = 1.0 / state.loss_scale
+        out, found = multi_tensor_axpby(inv, 1.0, new_scaled_grads, stashed_grads)
+        return out, state._replace(found_inf=state.found_inf | found)
+
+    def update_scale(self, state: LossScaleState) -> LossScaleState:
+        """End-of-step scale adjustment (``apex/amp/scaler.py:197-216``).
+
+        Consumes ``found_inf`` and resets it for the next step. Static mode
+        only clears the flag.
+        """
+        if not self.dynamic:
+            return state._replace(found_inf=jnp.asarray(False))
+        scale, unskipped, hyst = update_scale_hysteresis(
+            state.loss_scale,
+            state.unskipped,
+            state.hysteresis,
+            state.found_inf,
+            growth_factor=self.scale_factor,
+            backoff_factor=1.0 / self.scale_factor,
+            growth_interval=self.scale_window,
+            hysteresis=self.hysteresis,
+        )
+        scale = jnp.minimum(scale, self.max_loss_scale)
+        if self.min_loss_scale is not None:
+            scale = jnp.maximum(scale, self.min_loss_scale)
+        return LossScaleState(
+            loss_scale=scale, unskipped=unskipped, hysteresis=hyst, found_inf=jnp.asarray(False)
+        )
+
+    def loss_scale(self, state: LossScaleState) -> jax.Array:
+        return state.loss_scale
+
+    # -- checkpointing (``apex/amp/frontend.py:365-404`` parity) -----------
+    def state_dict(self, state: LossScaleState) -> dict:
+        return {
+            "loss_scale": float(jax.device_get(state.loss_scale)),
+            "unskipped": int(jax.device_get(state.unskipped)),
+            "hysteresis": int(jax.device_get(state.hysteresis)),
+            "dynamic": self.dynamic,
+        }
+
+    def load_state_dict(self, sd: dict) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.float32(sd["loss_scale"]),
+            unskipped=jnp.int32(sd.get("unskipped", 0)),
+            hysteresis=jnp.int32(sd.get("hysteresis", self.hysteresis)),
+            found_inf=jnp.asarray(False),
+        )

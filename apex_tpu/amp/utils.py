@@ -1,0 +1,66 @@
+"""Casting helpers + trace-scoped cast cache.
+
+Reference: ``apex/amp/utils.py:90-122`` — the fp16 cast cache that dedupes
+parameter casts within one iteration. Under jit the cache dedupes *traced
+ops*: repeated casts of the same traced array inside one autocast region
+become a single convert in the jaxpr (XLA would CSE them anyway; the cache
+keeps the jaxpr small and mirrors the reference's semantics of "one cast per
+tensor per iteration").
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_FLOAT_TYPES = (jnp.float64, jnp.float32, jnp.float16, jnp.bfloat16)
+
+
+def is_float_array(x) -> bool:
+    return isinstance(x, (jax.Array, jax.core.Tracer)) and jnp.issubdtype(
+        jnp.result_type(x), jnp.floating
+    )
+
+
+def maybe_cast(x, dtype, cache: dict | None = None):
+    """Cast floating arrays to ``dtype``; pass everything else through."""
+    if not is_float_array(x) or jnp.result_type(x) == dtype:
+        return x
+    if cache is not None:
+        key = (id(x), jnp.dtype(dtype).name)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+    out = x.astype(dtype)
+    if cache is not None:
+        cache[(id(x), jnp.dtype(dtype).name)] = out
+        # keep the source alive so id() keys stay unique for the trace
+        cache.setdefault("__refs__", []).append(x)
+    return out
+
+
+def maybe_low_precision(x, dtype=jnp.bfloat16, cache=None):
+    """fp32/fp64 -> low precision (reference ``utils.py`` maybe_half)."""
+    if is_float_array(x) and jnp.result_type(x) in (jnp.float32, jnp.float64):
+        return maybe_cast(x, dtype, cache)
+    return x
+
+
+def maybe_float(x, cache=None):
+    """fp16/bf16 -> fp32 (reference ``utils.py`` maybe_float)."""
+    if is_float_array(x) and jnp.result_type(x) in (jnp.float16, jnp.bfloat16):
+        return maybe_cast(x, jnp.float32, cache)
+    return x
+
+
+def casted_args(cast_fn, args, kwargs, cache=None):
+    new_args = [
+        jax.tree_util.tree_map(lambda t: cast_fn(t, cache=cache), a)
+        if not callable(a)
+        else a
+        for a in args
+    ]
+    new_kwargs = {
+        k: (jax.tree_util.tree_map(lambda t: cast_fn(t, cache=cache), v) if not callable(v) else v)
+        for k, v in kwargs.items()
+    }
+    return new_args, new_kwargs

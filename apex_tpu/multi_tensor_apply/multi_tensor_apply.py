@@ -1,0 +1,31 @@
+"""API-parity wrapper for fused multi-tensor ops.
+
+Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30`` — a thin
+callable that forwards ``(chunk_size, noop_flag, tensor_lists, *args)`` into an
+``amp_C`` CUDA op. On TPU there is no launch overhead to amortise and no chunk
+size: every op in ``apex_tpu.ops`` is a pure jittable function over pytrees,
+and XLA does the fusion. The wrapper survives purely so reference-style call
+sites keep working.
+"""
+from __future__ import annotations
+
+
+class MultiTensorApply:
+    """Callable forwarding to a functional multi-tensor op.
+
+    ``chunk_size`` is accepted and ignored (XLA tiles internally). The op is
+    called as ``op(*tensor_lists_and_args)`` and its return value — typically
+    ``(outputs, found_inf)`` — is passed straight through.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, *args, **kwargs):
+        return op(*args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply()

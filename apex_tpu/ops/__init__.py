@@ -1,0 +1,19 @@
+"""apex_tpu.ops: the kernel layer.
+
+TPU-native replacement for the reference's ``csrc/`` CUDA extension modules
+(``amp_C``, ``fused_layer_norm_cuda``, megatron softmax/rope kernels, ...).
+Elementwise/reduction "multi-tensor" ops are single-jit pytree computations —
+XLA fuses the chains that the CUDA build hand-fused — and the genuinely hot ops
+(normalization, softmax, attention, optimizer updates) additionally have Pallas
+TPU kernels, selected automatically on TPU backends with an XLA fallback
+elsewhere (CPU tests, interpret mode).
+"""
+from .multi_tensor import (  # noqa: F401
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_unscale_l2norm,
+    update_scale_hysteresis,
+    l2norm,
+    has_inf_or_nan,
+)

@@ -1,0 +1,202 @@
+"""Multi-tensor utility ops: the TPU equivalent of the reference's ``amp_C``.
+
+The reference implements these as chunked CUDA kernels over packed lists of
+tensor pointers (``csrc/multi_tensor_apply.cuh:16-133``) to amortise kernel
+launch overhead. On TPU, XLA already fuses an elementwise update over an entire
+pytree into few fused loops when the whole thing is traced in one ``jit``, so
+the idiomatic design is: every op is a pure function over a pytree of arrays,
+meant to be called from inside a jitted step. No chunking machinery survives —
+only the semantics:
+
+- ``multi_tensor_scale``       out = in * scale, flagging non-finite values
+  (``csrc/multi_tensor_scale_kernel.cu``)
+- ``multi_tensor_axpby``       out = a*x + b*y, flagging non-finite values
+  (``csrc/multi_tensor_axpby_kernel.cu``)
+- ``multi_tensor_l2norm``      global and optional per-tensor L2 norms
+  (``csrc/multi_tensor_l2norm_kernel.cu``)
+- ``multi_tensor_unscale_l2norm``  unscale + norm in one pass
+- ``update_scale_hysteresis``  loss-scale update with hysteresis
+  (``csrc/update_scale_hysteresis.cu:1-71``)
+
+"found inf" semantics: the CUDA kernels set a ``noop_flag`` buffer when they
+encounter inf/NaN; callers then skip the optimizer step. Here every op returns
+a ``found_inf`` boolean scalar alongside its outputs, and skip-step is a
+``lax.cond`` in the caller (see ``apex_tpu.amp.scaler``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def _leaves(tree: Pytree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+def has_inf_or_nan(tree: Pytree) -> jax.Array:
+    """True if any leaf of ``tree`` contains a non-finite value.
+
+    Mirrors the inf/nan screening every ``amp_C`` kernel performs inline
+    (e.g. ``csrc/multi_tensor_scale_kernel.cu`` noop_flag logic).
+    """
+    leaves = _leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [~jnp.all(jnp.isfinite(leaf.astype(jnp.float32))) for leaf in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def multi_tensor_scale(
+    tree: Pytree, scale: jax.Array | float, out_dtype: Optional[jnp.dtype] = None
+) -> Tuple[Pytree, jax.Array]:
+    """Scale every leaf by ``scale``; report whether any input was non-finite.
+
+    Reference: ``csrc/multi_tensor_scale_kernel.cu`` via
+    ``apex/amp/scaler.py:94`` (grad unscaling) and
+    ``apex/parallel/distributed.py:463-469`` (bucket copy-back).
+
+    Returns ``(scaled_tree, found_inf)``. When ``out_dtype`` is given each
+    output leaf is cast (the CUDA kernel supported cross-dtype in/out pairs
+    for fp16 model grads -> fp32 master grads).
+    """
+    scale = jnp.asarray(scale, dtype=jnp.float32)
+
+    def one(leaf):
+        out = leaf.astype(jnp.float32) * scale
+        bad = ~jnp.all(jnp.isfinite(out))
+        return out.astype(out_dtype or leaf.dtype), bad
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    outs, bads = zip(*[one(l) for l in leaves]) if leaves else ((), ())
+    found_inf = jnp.any(jnp.stack(bads)) if bads else jnp.asarray(False)
+    return jax.tree_util.tree_unflatten(treedef, list(outs)), found_inf
+
+
+def multi_tensor_axpby(
+    a: jax.Array | float,
+    b: jax.Array | float,
+    xs: Pytree,
+    ys: Pytree,
+    out_dtype: Optional[jnp.dtype] = None,
+) -> Tuple[Pytree, jax.Array]:
+    """out = a*x + b*y per leaf, flagging non-finite results.
+
+    Reference: ``csrc/multi_tensor_axpby_kernel.cu`` via
+    ``apex/amp/scaler.py:152`` (``unscale_with_stashed`` grad accumulation).
+    """
+    a = jnp.asarray(a, dtype=jnp.float32)
+    b = jnp.asarray(b, dtype=jnp.float32)
+
+    def one(x, y):
+        out = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        bad = ~jnp.all(jnp.isfinite(out))
+        return out.astype(out_dtype or x.dtype), bad
+
+    xl, treedef = jax.tree_util.tree_flatten(xs)
+    yl = jax.tree_util.tree_leaves(ys)
+    assert len(xl) == len(yl), "axpby requires matching pytrees"
+    outs, bads = zip(*[one(x, y) for x, y in zip(xl, yl)]) if xl else ((), ())
+    found_inf = jnp.any(jnp.stack(bads)) if bads else jnp.asarray(False)
+    return jax.tree_util.tree_unflatten(treedef, list(outs)), found_inf
+
+
+def _sq_sum(leaf: jax.Array) -> jax.Array:
+    leaf = leaf.astype(jnp.float32)
+    return jnp.sum(leaf * leaf)
+
+
+def multi_tensor_l2norm(
+    tree: Pytree, per_tensor: bool = False
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Global (and optionally per-leaf) L2 norm over a pytree.
+
+    Reference: ``csrc/multi_tensor_l2norm_kernel.cu`` (600 LoC of chunked
+    reduction) — here a tree-reduce XLA fuses on its own. Used by FusedLAMB
+    (``apex/optimizers/fused_lamb.py:124-137``), grad clipping
+    (``apex/contrib/clip_grad/clip_grad.py``) and pipeline utils
+    (``pipeline_parallel/utils.py:213``).
+
+    Returns ``(global_norm, per_tensor_norms_or_None)`` where
+    ``per_tensor_norms`` is a 1-D fp32 array, one entry per leaf in flatten
+    order.
+    """
+    leaves = _leaves(tree)
+    if not leaves:
+        zero = jnp.zeros((), jnp.float32)
+        return zero, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
+    sq = jnp.stack([_sq_sum(l) for l in leaves])
+    gnorm = jnp.sqrt(jnp.sum(sq))
+    return gnorm, (jnp.sqrt(sq) if per_tensor else None)
+
+
+def l2norm(tree: Pytree) -> jax.Array:
+    """Convenience: global L2 norm of a pytree."""
+    return multi_tensor_l2norm(tree)[0]
+
+
+def multi_tensor_unscale_l2norm(
+    tree: Pytree, inv_scale: jax.Array | float, per_tensor: bool = False
+) -> Tuple[jax.Array, Optional[jax.Array], jax.Array]:
+    """Unscale by ``inv_scale`` then take L2 norms, flagging non-finite input.
+
+    Reference: ``multi_tensor_unscale_l2norm`` in
+    ``csrc/multi_tensor_l2norm_kernel.cu`` (used by
+    ``FusedMixedPrecisionLamb`` and ``DistributedFusedAdam`` grad-norm paths).
+    Returns ``(global_norm, per_tensor_norms_or_None, found_inf)``.
+    """
+    inv_scale = jnp.asarray(inv_scale, jnp.float32)
+    leaves = _leaves(tree)
+    if not leaves:
+        zero = jnp.zeros((), jnp.float32)
+        return zero, (jnp.zeros((0,), jnp.float32) if per_tensor else None), jnp.asarray(False)
+    unscaled = [l.astype(jnp.float32) * inv_scale for l in leaves]
+    found_inf = jnp.any(jnp.stack([~jnp.all(jnp.isfinite(u)) for u in unscaled]))
+    sq = jnp.stack([jnp.sum(u * u) for u in unscaled])
+    gnorm = jnp.sqrt(jnp.sum(sq))
+    return gnorm, (jnp.sqrt(sq) if per_tensor else None), found_inf
+
+
+def update_scale_hysteresis(
+    scale: jax.Array,
+    growth_tracker: jax.Array,
+    hysteresis_tracker: jax.Array,
+    found_inf: jax.Array,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 2000,
+    hysteresis: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dynamic loss-scale update with hysteresis, as a pure function.
+
+    Behaviour matched against ``csrc/update_scale_hysteresis.cu:1-71``:
+
+    - overflow: decrement ``hysteresis_tracker``; the scale is multiplied by
+      ``backoff_factor`` only once the allowance is exhausted; the growth
+      tracker always resets.
+    - clean step: increment growth tracker; at ``growth_interval`` multiply
+      the scale by ``growth_factor`` (skipped if that would overflow fp32) and
+      reset the tracker. Every clean step refills the hysteresis allowance.
+
+    All inputs/outputs are scalars (fp32 scale, int32 trackers) so the whole
+    update lives inside ``jit`` — the analogue of the reference keeping them
+    as device tensors for CUDA-graph capture.
+    """
+    scale = jnp.asarray(scale, jnp.float32)
+    growth_tracker = jnp.asarray(growth_tracker, jnp.int32)
+    hysteresis_tracker = jnp.asarray(hysteresis_tracker, jnp.int32)
+    found = jnp.asarray(found_inf, jnp.bool_)
+
+    hyst_after = jnp.maximum(hysteresis_tracker - 1, 0)
+    backoff = found & (hyst_after <= 0)
+    grown = (~found) & (growth_tracker + 1 >= growth_interval)
+
+    grown_scale = scale * growth_factor
+    grown_scale = jnp.where(jnp.isfinite(grown_scale), grown_scale, scale)
+    new_scale = jnp.where(backoff, scale * backoff_factor, jnp.where(grown, grown_scale, scale))
+    new_growth = jnp.where(found | grown, 0, growth_tracker + 1)
+    new_hyst = jnp.where(found, hyst_after, jnp.int32(hysteresis))
+    return new_scale, new_growth, new_hyst

@@ -1,0 +1,15 @@
+"""apex_tpu.optimizers: fused multi-tensor optimizers.
+
+Mirrors ``apex/optimizers/__init__.py:1-6``: FusedAdam (+AdamW, +the fork's
+``no_update_mv_step``), FusedLAMB, FusedSGD, FusedNovoGrad, FusedAdagrad,
+FusedMixedPrecisionLamb. Each is one jit-fusable pytree update with fp32
+moments, overflow noop via ``lax.cond``, optional fp32 master weights, and an
+optax adapter. The ZeRO-sharded variants live in
+``apex_tpu.contrib.optimizers``.
+"""
+from .fused_adam import FusedAdam, FusedAdamW, FusedAdamState  # noqa: F401
+from .fused_lamb import FusedLAMB, FusedMixedPrecisionLamb, FusedLAMBState  # noqa: F401
+from .fused_sgd import FusedSGD, FusedSGDState  # noqa: F401
+from .fused_novograd import FusedNovoGrad, FusedNovoGradState  # noqa: F401
+from .fused_adagrad import FusedAdagrad, FusedAdagradState  # noqa: F401
+from ._common import FusedOptimizer  # noqa: F401

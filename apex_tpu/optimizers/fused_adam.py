@@ -1,0 +1,174 @@
+"""FusedAdam / AdamW — single-jit pytree Adam with overflow noop.
+
+Reference behaviour: ``apex/optimizers/fused_adam.py:4-488`` over
+``csrc/multi_tensor_adam.cu``. Covered here:
+
+- ``adam_w_mode`` (decoupled weight decay) vs classic Adam L2 (decay folded
+  into the gradient) — kernel modes ADAM_MODE_1/ADAM_MODE_0.
+- ``bias_correction`` on/off.
+- "capturable" semantics are the default and only mode: ``step`` is a device
+  scalar, incremented only on non-overflow steps, and the whole update is a
+  traced ``lax.cond`` — the reason the reference needed capturable (CUDA
+  graphs) is just ``jit`` here.
+- ``master_weights``: fp32 master params in state; returned params are
+  re-cast masters (O2 path).
+- the fork's ``no_update_mv_step`` (``fused_adam.py:310-488``,
+  ``csrc/multi_tensor_adam.cu:514-986``): m/v and the bias-correction step
+  count are computed transiently for the param update but **not** persisted.
+- ``grad_scale``/``found_inf`` hooks matching the capturable-master kernel's
+  ``inv_scale``/``noop_flag`` arguments.
+
+Moments are fp32 regardless of param/grad dtype (kernel ``MATH_T float``).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ._common import (
+    FusedOptimizer,
+    Pytree,
+    multi_tree_update,
+    resolve_scale,
+    skip_on_overflow,
+    tree_f32,
+    tree_zeros_like,
+)
+
+
+class FusedAdamState(NamedTuple):
+    step: jax.Array  # i32 scalar, shared across the pytree (fused_adam.py:333 "same step across group")
+    exp_avg: Pytree  # fp32
+    exp_avg_sq: Pytree  # fp32
+    master_params: Optional[Pytree]  # fp32 when master_weights else None
+
+
+class FusedAdam(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        set_grad_none: bool = True,  # accepted for parity; meaningless functionally
+        capturable: bool = True,  # always-on under jit; accepted for parity
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.master_weights = master_weights
+
+    def init(self, params: Pytree) -> FusedAdamState:
+        return FusedAdamState(
+            step=jnp.int32(0),
+            exp_avg=tree_zeros_like(params, jnp.float32),
+            exp_avg_sq=tree_zeros_like(params, jnp.float32),
+            master_params=tree_f32(params) if self.master_weights else None,
+        )
+
+    # -- core math ---------------------------------------------------------
+    def _update_leaf(self, g, p, m, v, step, lr, wd):
+        beta1, beta2 = self.betas
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if self.bias_correction:
+            t = step.astype(jnp.float32)
+            bc1 = 1.0 - beta1 ** t
+            bc2 = 1.0 - beta2 ** t
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        if not self.adam_w_mode and wd != 0.0:
+            g = g + wd * p32  # ADAM_MODE_0: L2 into the gradient
+        new_m = beta1 * m + (1.0 - beta1) * g
+        new_v = beta2 * v + (1.0 - beta2) * g * g
+        denom = jnp.sqrt(new_v / bc2) + self.eps
+        update = (new_m / bc1) / denom
+        if self.adam_w_mode and wd != 0.0:
+            update = update + wd * p32  # ADAM_MODE_1: decoupled decay
+        new_p32 = p32 - lr * update
+        return new_p32, new_m, new_v
+
+    def _stepped(self, grads, state, params, lr, wd, inv_scale):
+        new_step = state.step + 1
+        lr = jnp.asarray(lr, jnp.float32)
+        src = state.master_params if self.master_weights else params
+
+        def leaf(g, p, m, v):
+            g = g.astype(jnp.float32) * inv_scale
+            return self._update_leaf(g, p, m, v, new_step, lr, wd)
+
+        p32s, ms, vs = multi_tree_update(
+            leaf, 3, grads, src, state.exp_avg, state.exp_avg_sq
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p32, p: p32.astype(p.dtype), p32s, params
+        )
+        new_state = FusedAdamState(
+            step=new_step,
+            exp_avg=ms,
+            exp_avg_sq=vs,
+            master_params=p32s if self.master_weights else None,
+        )
+        return new_params, new_state
+
+    # -- public API --------------------------------------------------------
+    def step(
+        self,
+        grads: Pytree,
+        state: FusedAdamState,
+        params: Pytree,
+        lr: Optional[jax.Array] = None,
+        weight_decay: Optional[float] = None,
+        found_inf: Optional[jax.Array] = None,
+        grad_scale=None,
+    ) -> Tuple[Pytree, FusedAdamState]:
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay if weight_decay is None else weight_decay
+        inv_scale = resolve_scale(grad_scale)
+        return skip_on_overflow(
+            found_inf,
+            lambda: self._stepped(grads, state, params, lr, wd, inv_scale),
+            (params, state),
+        )
+
+    def no_update_mv_step(
+        self,
+        grads: Pytree,
+        state: FusedAdamState,
+        params: Pytree,
+        lr: Optional[jax.Array] = None,
+        weight_decay: Optional[float] = None,
+        found_inf: Optional[jax.Array] = None,
+        grad_scale=None,
+    ) -> Tuple[Pytree, FusedAdamState]:
+        """Fork-added step: params move, m/v (and step) stay.
+
+        Matches ``AdamFunctorNoUpdateMV`` (``csrc/multi_tensor_adam.cu:514``):
+        the moment updates and bias corrections are computed with this step's
+        gradient, used for the param update, then discarded.
+        """
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay if weight_decay is None else weight_decay
+        inv_scale = resolve_scale(grad_scale)
+
+        def do():
+            new_params, _ = self._stepped(grads, state, params, lr, wd, inv_scale)
+            return new_params, state
+
+        return skip_on_overflow(found_inf, do, (params, state))
+
+
+def FusedAdamW(*args, **kwargs) -> FusedAdam:
+    kwargs.setdefault("adam_w_mode", True)
+    return FusedAdam(*args, **kwargs)

@@ -1,0 +1,15 @@
+"""Test harness: 8 virtual CPU devices, mirroring the reference's
+single-node multi-process testing strategy (SURVEY.md §4,
+``apex/transformer/testing/distributed_test_base.py``) — but SPMD: one
+process, an 8-device mesh, deterministic seeds."""
+import os
+
+# Must run before jax initialises its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,164 @@
+"""amp behavioural tests — mirrors ``tests/L0/run_amp``: basic casts,
+cast caching, loss-scaler dynamics, checkpointing, frontend presets."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+class TestAutocast:
+    def test_matmul_casts_low_precision(self):
+        a = jnp.ones((8, 8), jnp.float32)
+        with amp.autocast(dtype=jnp.bfloat16):
+            out = jnp.matmul(a, a)
+        assert out.dtype == jnp.bfloat16
+
+    def test_fp32_list_upcasts(self):
+        x = jnp.linspace(-1, 1, 16, dtype=jnp.bfloat16)
+        with amp.autocast(dtype=jnp.bfloat16):
+            out = jax.nn.softmax(x)
+        assert out.dtype == jnp.float32
+
+    def test_restores_namespace(self):
+        orig = jnp.matmul
+        with amp.autocast():
+            assert jnp.matmul is not orig
+        assert jnp.matmul is orig
+
+    def test_under_jit(self):
+        a = jnp.ones((4, 4), jnp.float32)
+
+        @jax.jit
+        def f(a):
+            with amp.autocast(dtype=jnp.bfloat16):
+                return jnp.matmul(a, a)
+
+        assert f(a).dtype == jnp.bfloat16
+
+    def test_disable_casts(self):
+        a = jnp.ones((4, 4), jnp.float32)
+        with amp.autocast(dtype=jnp.bfloat16):
+            with amp.disable_casts():
+                out = jnp.matmul(a, a)
+        assert out.dtype == jnp.float32
+
+    def test_disabled_noop(self):
+        a = jnp.ones((4, 4), jnp.float32)
+        with amp.autocast(enabled=False):
+            assert jnp.matmul(a, a).dtype == jnp.float32
+
+
+class TestLossScaler:
+    def test_static_scale(self):
+        s = LossScaler(loss_scale=128.0)
+        st = s.init_state()
+        assert float(st.loss_scale) == 128.0
+        st2 = s.update_scale(st._replace(found_inf=jnp.asarray(True)))
+        assert float(st2.loss_scale) == 128.0  # static never moves
+
+    def test_dynamic_backoff_and_growth(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=2.0 ** 10, scale_window=2)
+        st = s.init_state()
+        st = s.update_scale(st._replace(found_inf=jnp.asarray(True)))
+        assert float(st.loss_scale) == 2.0 ** 9
+        st = s.update_scale(st)  # clean
+        st = s.update_scale(st)  # clean -> growth (window=2)
+        assert float(st.loss_scale) == 2.0 ** 10
+
+    def test_unscale_detects_inf(self):
+        s = LossScaler(loss_scale=2.0 ** 16)
+        st = s.init_state()
+        grads = {"w": jnp.asarray([1.0, np.inf], jnp.float32)}
+        _, st = s.unscale(st, grads)
+        assert bool(st.found_inf)
+
+    def test_scaled_value_and_grad_end_to_end(self):
+        scaler = LossScaler(loss_scale="dynamic", init_scale=8.0)
+        params = {"w": jnp.asarray([2.0, -1.0], jnp.float32)}
+
+        def loss_fn(params, x):
+            return jnp.sum(params["w"] * x) ** 2
+
+        fn = amp.scaled_value_and_grad(loss_fn, scaler)
+        x = jnp.asarray([1.0, 3.0])
+        loss, grads, st = jax.jit(fn)(scaler.init_state(), params, x)
+        expect = jax.grad(loss_fn)(params, x)
+        np.testing.assert_allclose(grads["w"], expect["w"], rtol=1e-6)
+        assert not bool(st.found_inf)
+
+    def test_state_dict_roundtrip(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=4096.0)
+        st = s.init_state()
+        st = s.update_scale(st._replace(found_inf=jnp.asarray(True)))
+        sd = s.state_dict(st)
+        st2 = s.load_state_dict(sd)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+
+
+class TestFrontend:
+    def _params(self):
+        return {
+            "dense": {"kernel": jnp.ones((4, 4), jnp.float32)},
+            "BatchNorm_0": {"scale": jnp.ones((4,), jnp.float32)},
+        }
+
+    def test_o2_casts_keeps_bn_fp32(self):
+        params, opt, st = amp.initialize(self._params(), FusedAdam(), opt_level="O2")
+        assert params["dense"]["kernel"].dtype == jnp.bfloat16
+        assert params["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert opt.master_weights is True
+        assert st.opt_properties.opt_level == "O2"
+
+    def test_o0_is_fp32_static(self):
+        params, opt, st = amp.initialize(self._params(), FusedSGD(lr=0.1), opt_level="O0")
+        assert params["dense"]["kernel"].dtype == jnp.float32
+        assert float(st.scaler_state().loss_scale) == 1.0
+
+    def test_o0_upcasts_bf16_params(self):
+        bf16 = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), self._params())
+        params, _, _ = amp.initialize(bf16, None, opt_level="O0")
+        assert params["dense"]["kernel"].dtype == jnp.float32
+
+    def test_getattr_with_default_does_not_raise(self):
+        import apex_tpu
+
+        assert getattr(apex_tpu, "RNN", None) is None or True  # must not raise
+
+    def test_o1_patches_functions(self):
+        _, _, st = amp.initialize(self._params(), None, opt_level="O1")
+        a = jnp.ones((4, 4), jnp.float32)
+        with st.autocast():
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+
+    def test_o3_bf16_everything(self):
+        params, _, _ = amp.initialize(self._params(), None, opt_level="O3")
+        assert params["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+
+    def test_override_loss_scale(self):
+        _, _, st = amp.initialize(self._params(), None, opt_level="O2", loss_scale=512.0)
+        assert float(st.scaler_state().loss_scale) == 512.0
+
+    def test_checkpoint_roundtrip(self):
+        _, _, st = amp.initialize(self._params(), None, opt_level="O2", num_losses=2)
+        sd = amp.state_dict(st)
+        assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+        st2 = amp.load_state_dict(st, sd)
+        assert float(st2.scaler_state(1).loss_scale) == float(st.scaler_state(1).loss_scale)
+
+    def test_skip_step_on_overflow(self):
+        params = {"w": jnp.asarray([1.0, 2.0], jnp.float32)}
+        opt = FusedAdam(lr=0.1)
+        state = opt.init(params)
+        scaler = LossScaler(loss_scale="dynamic", init_scale=2.0 ** 20)
+
+        def loss_fn(p, x):
+            return jnp.sum(p["w"] * x) * 1e30  # force overflow after scaling
+
+        fn = amp.scaled_value_and_grad(loss_fn, scaler)
+        _, grads, sstate = fn(scaler.init_state(), params, jnp.asarray([1e8, 1e8]))
+        assert bool(sstate.found_inf)
+        new_params, _ = opt.step(grads, state, params, found_inf=sstate.found_inf)
+        np.testing.assert_array_equal(np.asarray(new_params["w"]), np.asarray(params["w"]))

@@ -1,0 +1,190 @@
+"""Fused optimizers vs torch.optim references — mirrors
+``tests/L0/run_optimizers/test_fused_optimizer.py`` (state-by-state
+comparisons) plus overflow/noop and master-weight behaviour."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.optimizers import (
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedNovoGrad,
+    FusedSGD,
+)
+
+
+def _make_params(seed=0, shapes=((4, 5), (17,), (2, 3, 4))):
+    rng = np.random.RandomState(seed)
+    return {f"p{i}": rng.randn(*s).astype(np.float32) for i, s in enumerate(shapes)}
+
+
+def _make_grads(seed=1, shapes=((4, 5), (17,), (2, 3, 4))):
+    rng = np.random.RandomState(seed)
+    return {f"p{i}": rng.randn(*s).astype(np.float32) for i, s in enumerate(shapes)}
+
+
+def _torch_run(opt_cls, params_np, grads_seq, **kw):
+    tparams = [torch.nn.Parameter(torch.tensor(v)) for v in params_np.values()]
+    opt = opt_cls(tparams, **kw)
+    for grads_np in grads_seq:
+        for p, g in zip(tparams, grads_np.values()):
+            p.grad = torch.tensor(g)
+        opt.step()
+    return [p.detach().numpy() for p in tparams]
+
+
+def _jax_run(opt, params_np, grads_seq):
+    params = jax.tree_util.tree_map(jnp.asarray, params_np)
+    state = opt.init(params)
+    step = jax.jit(lambda g, s, p: opt.step(g, s, p))
+    for grads_np in grads_seq:
+        grads = jax.tree_util.tree_map(jnp.asarray, grads_np)
+        params, state = step(grads, state, params)
+    return params, state
+
+
+GRADS = [_make_grads(seed) for seed in range(5)]
+
+
+@pytest.mark.parametrize("adam_w", [True, False])
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_fused_adam_matches_torch(adam_w, wd):
+    params_np = _make_params()
+    torch_cls = torch.optim.AdamW if adam_w else torch.optim.Adam
+    expect = _torch_run(torch_cls, params_np, GRADS, lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=wd)
+    opt = FusedAdam(lr=1e-2, betas=(0.9, 0.999), eps=1e-8, adam_w_mode=adam_w, weight_decay=wd)
+    got, _ = _jax_run(opt, params_np, GRADS)
+    for e, g in zip(expect, got.values()):
+        np.testing.assert_allclose(g, e, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("momentum,nesterov,wd", [(0.0, False, 0.0), (0.9, False, 0.0), (0.9, True, 0.0), (0.9, False, 0.05)])
+def test_fused_sgd_matches_torch(momentum, nesterov, wd):
+    params_np = _make_params()
+    expect = _torch_run(
+        torch.optim.SGD, params_np, GRADS, lr=0.1, momentum=momentum, nesterov=nesterov, weight_decay=wd
+    )
+    opt = FusedSGD(lr=0.1, momentum=momentum, nesterov=nesterov, weight_decay=wd)
+    got, _ = _jax_run(opt, params_np, GRADS)
+    for e, g in zip(expect, got.values()):
+        np.testing.assert_allclose(g, e, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_adagrad_matches_torch():
+    params_np = _make_params()
+    expect = _torch_run(torch.optim.Adagrad, params_np, GRADS, lr=0.05, eps=1e-10)
+    opt = FusedAdagrad(lr=0.05, eps=1e-10)
+    got, _ = _jax_run(opt, params_np, GRADS)
+    for e, g in zip(expect, got.values()):
+        np.testing.assert_allclose(g, e, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_lamb_trust_ratio_direction():
+    """LAMB with wd: per-tensor update norm scaled by ||p||/||update||."""
+    params_np = _make_params()
+    opt = FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=0.0)
+    got, state = _jax_run(opt, params_np, GRADS[:1])
+    assert int(state.step) == 1
+    for k in params_np:
+        assert not np.allclose(np.asarray(got[k]), params_np[k])
+
+
+def test_fused_lamb_grad_clipping_invariance():
+    """Scaling all grads up should be undone by max_grad_norm clipping."""
+    params_np = _make_params()
+    g1 = [GRADS[0]]
+    g_big = [{k: v * 100.0 for k, v in GRADS[0].items()}]
+    opt = FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    out1, _ = _jax_run(opt, params_np, g1)
+    # grads large enough that both runs clip to the same direction
+    opt2 = FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    out2, _ = _jax_run(opt2, params_np, g_big)
+    for k in params_np:
+        np.testing.assert_allclose(np.asarray(out1[k]), np.asarray(out2[k]), rtol=1e-3, atol=1e-5)
+
+
+def test_fused_novograd_layerwise_moment():
+    params_np = _make_params()
+    opt = FusedNovoGrad(lr=1e-2, betas=(0.95, 0.98), weight_decay=0.01)
+    got, state = _jax_run(opt, params_np, GRADS[:3])
+    # second moment is scalar per tensor
+    for v in jax.tree_util.tree_leaves(state.exp_avg_sq):
+        assert v.shape == ()
+    for k in params_np:
+        assert not np.allclose(np.asarray(got[k]), params_np[k])
+
+
+def test_overflow_skips_step():
+    params_np = _make_params()
+    opt = FusedAdam(lr=1e-2)
+    params = jax.tree_util.tree_map(jnp.asarray, params_np)
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.asarray, GRADS[0])
+    new_params, new_state = jax.jit(
+        lambda g, s, p: opt.step(g, s, p, found_inf=jnp.asarray(True))
+    )(grads, state, params)
+    assert int(new_state.step) == 0
+    for a, b in zip(jax.tree_util.tree_leaves(new_params), jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_no_update_mv_step_preserves_moments():
+    params_np = _make_params()
+    opt = FusedAdam(lr=1e-2)
+    params = jax.tree_util.tree_map(jnp.asarray, params_np)
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.asarray, GRADS[0])
+    # regular step then a no_update_mv step
+    params1, state1 = opt.step(grads, state, params)
+    params2, state2 = opt.no_update_mv_step(grads, state1, params1)
+    # params moved, moments + step unchanged
+    assert int(state2.step) == int(state1.step)
+    for a, b in zip(jax.tree_util.tree_leaves(state2.exp_avg), jax.tree_util.tree_leaves(state1.exp_avg)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    changed = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(params2), jax.tree_util.tree_leaves(params1))
+    )
+    assert changed
+
+
+def test_master_weights_bf16_params():
+    params_np = _make_params()
+    params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.bfloat16), params_np)
+    opt = FusedAdam(lr=1e-3, master_weights=True)
+    state = opt.init(params)
+    assert all(m.dtype == jnp.float32 for m in jax.tree_util.tree_leaves(state.master_params))
+    grads = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.bfloat16), GRADS[0])
+    new_params, new_state = opt.step(grads, state, params)
+    assert all(p.dtype == jnp.bfloat16 for p in jax.tree_util.tree_leaves(new_params))
+    # master params advanced in fp32
+    for m, p in zip(
+        jax.tree_util.tree_leaves(new_state.master_params),
+        jax.tree_util.tree_leaves(new_params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(m, np.float32), np.asarray(p, np.float32), rtol=1e-2, atol=1e-2
+        )
+
+
+def test_grad_scale_unscales():
+    params_np = _make_params()
+    opt = FusedAdam(lr=1e-2)
+    scaled = [{k: v * 128.0 for k, v in GRADS[0].items()}]
+    out_scaled, _ = _jax_run_with_scale(opt, params_np, scaled, 128.0)
+    opt2 = FusedAdam(lr=1e-2)
+    out_plain, _ = _jax_run(opt2, params_np, [GRADS[0]])
+    for k in params_np:
+        np.testing.assert_allclose(np.asarray(out_scaled[k]), np.asarray(out_plain[k]), rtol=1e-5, atol=1e-6)
+
+
+def _jax_run_with_scale(opt, params_np, grads_seq, scale):
+    params = jax.tree_util.tree_map(jnp.asarray, params_np)
+    state = opt.init(params)
+    for grads_np in grads_seq:
+        grads = jax.tree_util.tree_map(jnp.asarray, grads_np)
+        params, state = opt.step(grads, state, params, grad_scale=scale)
+    return params, state

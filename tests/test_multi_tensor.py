@@ -1,0 +1,101 @@
+"""Tests for apex_tpu.ops.multi_tensor — mirrors
+``tests/L0/run_amp/test_multi_tensor_scale.py`` etc.: op-vs-eager-math plus
+overflow-flag cases."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    multi_tensor_unscale_l2norm,
+    update_scale_hysteresis,
+)
+
+
+def _tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "a": jnp.asarray(rng.randn(4, 5), jnp.float32),
+        "b": [jnp.asarray(rng.randn(37), jnp.float32), jnp.asarray(rng.randn(2, 3, 4), jnp.float32)],
+    }
+
+
+def test_scale_matches_eager():
+    t = _tree()
+    out, found = jax.jit(lambda x: multi_tensor_scale(x, 0.125))(t)
+    for o, i in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(t)):
+        np.testing.assert_allclose(o, np.asarray(i) * 0.125, rtol=1e-6)
+    assert not bool(found)
+
+
+@pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+def test_scale_flags_overflow(bad):
+    t = _tree()
+    t["a"] = t["a"].at[1, 2].set(bad)
+    _, found = multi_tensor_scale(t, 1.0)
+    assert bool(found)
+
+
+def test_scale_cross_dtype():
+    t = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), _tree())
+    out, _ = multi_tensor_scale(t, 2.0, out_dtype=jnp.float32)
+    assert all(o.dtype == jnp.float32 for o in jax.tree_util.tree_leaves(out))
+
+
+def test_axpby():
+    x, y = _tree(0), _tree(1)
+    out, found = multi_tensor_axpby(2.0, -3.0, x, y)
+    for o, a, b in zip(*(jax.tree_util.tree_leaves(t) for t in (out, x, y))):
+        np.testing.assert_allclose(o, 2.0 * np.asarray(a) - 3.0 * np.asarray(b), rtol=1e-6)
+    assert not bool(found)
+
+
+def test_l2norm_global_and_per_tensor():
+    t = _tree()
+    leaves = jax.tree_util.tree_leaves(t)
+    gnorm, per = multi_tensor_l2norm(t, per_tensor=True)
+    expect = np.sqrt(sum(float(np.sum(np.asarray(l) ** 2)) for l in leaves))
+    np.testing.assert_allclose(float(gnorm), expect, rtol=1e-6)
+    assert per.shape == (len(leaves),)
+    for p, l in zip(np.asarray(per), leaves):
+        np.testing.assert_allclose(p, np.linalg.norm(np.asarray(l).ravel()), rtol=1e-5)
+
+
+def test_unscale_l2norm_flags_inf():
+    t = _tree()
+    t["a"] = t["a"].at[0, 0].set(np.inf)
+    gnorm, _, found = multi_tensor_unscale_l2norm(t, 0.5)
+    assert bool(found)
+
+
+class TestUpdateScaleHysteresis:
+    def run(self, scale, growth, hyst, found, **kw):
+        s, g, h = update_scale_hysteresis(
+            jnp.float32(scale), jnp.int32(growth), jnp.int32(hyst),
+            jnp.asarray(found), **kw
+        )
+        return float(s), int(g), int(h)
+
+    def test_clean_step_grows_at_interval(self):
+        s, g, h = self.run(1024.0, 1999, 2, False, growth_interval=2000, hysteresis=2)
+        assert s == 2048.0 and g == 0 and h == 2
+
+    def test_clean_step_increments(self):
+        s, g, h = self.run(1024.0, 10, 2, False, growth_interval=2000, hysteresis=2)
+        assert s == 1024.0 and g == 11 and h == 2
+
+    def test_overflow_consumes_hysteresis_before_backoff(self):
+        # hysteresis=2: first overflow only decrements
+        s, g, h = self.run(1024.0, 500, 2, True, hysteresis=2)
+        assert s == 1024.0 and g == 0 and h == 1
+        # second overflow backs off
+        s, g, h = self.run(1024.0, 0, 1, True, hysteresis=2)
+        assert s == 512.0 and g == 0 and h == 0
+
+    def test_growth_clamps_to_finite(self):
+        big = float(np.float32(3.0e38))
+        s, _, _ = self.run(big, 1999, 1, False, growth_interval=2000)
+        assert s == big  # growing would overflow fp32 -> unchanged
