@@ -1,0 +1,149 @@
+"""FusedLayerNorm / FusedRMSNorm modules + functional API.
+
+Reference: ``apex/normalization/fused_layer_norm.py`` (957 LoC): autograd
+Functions over ``fused_layer_norm_cuda`` plus module classes, the
+``memory_efficient`` flag, ``manual_rms_norm`` fallback, and the
+``MixedFused*`` Megatron variants (weights kept fp32 while activations run
+bf16/fp16 — the "mixed dtype" kernels).
+
+Here the autograd Functions are the ``custom_vjp`` entry points in
+``apex_tpu.ops.layer_norm`` (Pallas on TPU, XLA elsewhere) and the module
+classes are flax ``nn.Module``s. ``FusedLayerNorm`` parameters follow
+``param_dtype``; the Mixed variants pin ``param_dtype=fp32``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.layer_norm import layer_norm as _layer_norm_op
+from ..ops.layer_norm import rms_norm as _rms_norm_op
+
+Shape = Union[int, Sequence[int]]
+
+
+def _norm_shape(normalized_shape: Shape):
+    if isinstance(normalized_shape, (int, np.integer)):
+        return (int(normalized_shape),)
+    return tuple(int(d) for d in normalized_shape)
+
+
+def _check_shape(x, ns):
+    if tuple(x.shape[x.ndim - len(ns):]) != ns:
+        raise ValueError(
+            f"normalized_shape {ns} does not match trailing input dims "
+            f"{tuple(x.shape)}"
+        )
+
+
+# -- functional API (reference's fused_layer_norm_affine etc.) --------------
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5, memory_efficient=False):
+    ns = _norm_shape(normalized_shape)
+    _check_shape(x, ns)
+    return _layer_norm_op(x, weight, bias, len(ns), eps, memory_efficient)
+
+
+def fused_layer_norm(x, normalized_shape, eps=1e-5, memory_efficient=False):
+    ns = _norm_shape(normalized_shape)
+    _check_shape(x, ns)
+    return _layer_norm_op(x, None, None, len(ns), eps, memory_efficient)
+
+
+def fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5, memory_efficient=False):
+    ns = _norm_shape(normalized_shape)
+    _check_shape(x, ns)
+    return _rms_norm_op(x, weight, len(ns), eps, memory_efficient)
+
+
+def fused_rms_norm(x, normalized_shape, eps=1e-5, memory_efficient=False):
+    ns = _norm_shape(normalized_shape)
+    _check_shape(x, ns)
+    return _rms_norm_op(x, None, len(ns), eps, memory_efficient)
+
+
+def mixed_dtype_fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5, memory_efficient=False):
+    return fused_layer_norm_affine(x, weight, bias, normalized_shape, eps, memory_efficient)
+
+
+def mixed_dtype_fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5, memory_efficient=False):
+    return fused_rms_norm_affine(x, weight, normalized_shape, eps, memory_efficient)
+
+
+def manual_rms_norm(x, normalized_shape, weight, eps):
+    """Pure-jnp fallback with the reference argument order
+    ``(input, normalized_shape, weight, eps)``
+    (``apex/normalization/fused_layer_norm.py:22``)."""
+    ns = _norm_shape(normalized_shape)
+    dims = tuple(range(x.ndim - len(ns), x.ndim))
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=dims, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = weight * y
+    return y.astype(x.dtype)
+
+
+# -- module classes ----------------------------------------------------------
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in LayerNorm module (reference module class near the end of
+    ``apex/normalization/fused_layer_norm.py``)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ns = _norm_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param(
+                "weight", nn.initializers.ones, ns, self.param_dtype
+            )
+            bias = self.param("bias", nn.initializers.zeros, ns, self.param_dtype)
+            return _layer_norm_op(x, weight, bias, len(ns), self.eps, self.memory_efficient)
+        return _layer_norm_op(x, None, None, len(ns), self.eps, self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ns = _norm_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param(
+                "weight", nn.initializers.ones, ns, self.param_dtype
+            )
+            return _rms_norm_op(x, weight, len(ns), self.eps, self.memory_efficient)
+        return _rms_norm_op(x, None, len(ns), self.eps, self.memory_efficient)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Megatron-compatible: fp32 params pinned under low-precision
+    activations (reference ``fused_layer_norm.py:347``). Overriding
+    ``param_dtype`` is rejected — "mixed" *is* the fp32-params contract."""
+
+    def __post_init__(self):
+        if self.param_dtype != jnp.float32:
+            raise ValueError("MixedFusedLayerNorm pins param_dtype=float32")
+        super().__post_init__()
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """Reference ``fused_layer_norm.py:370``; fp32 params pinned."""
+
+    def __post_init__(self):
+        if self.param_dtype != jnp.float32:
+            raise ValueError("MixedFusedRMSNorm pins param_dtype=float32")
+        super().__post_init__()

@@ -4,12 +4,20 @@ single-node multi-process testing strategy (SURVEY.md §4,
 process, an 8-device mesh, deterministic seeds."""
 import os
 
-# Must run before jax initialises its backends.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Must run before jax initialises its backends. NB: the environment's
+# sitecustomize imports jax at interpreter boot (axon TPU plugin), so plain
+# env vars are too late — use jax.config.update, which works as long as no
+# backend has been initialised yet.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.default_backend() == "cpu" and len(jax.devices()) == 8, (
+    "test harness expects 8 virtual CPU devices; got "
+    f"{jax.default_backend()} x{len(jax.devices())}"
+)
