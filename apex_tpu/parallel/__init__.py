@@ -1,0 +1,20 @@
+"""Data-parallel layer: DDP-style grad sync, SyncBatchNorm, LARC.
+
+TPU-native re-design of ``apex/parallel/__init__.py:9-21``.
+"""
+from .distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    flatten,
+    sync_gradients,
+    unflatten,
+)
+from .LARC import LARC, larc_adjust_gradients, larc_transform  # noqa: F401
+from .sync_batchnorm import sync_batch_norm  # noqa: F401
+
+try:
+    from .sync_batchnorm import SyncBatchNorm, convert_syncbn_model  # noqa: F401
+except ImportError:  # flax unavailable
+    pass
+
+from .multiproc import initialize_distributed  # noqa: F401

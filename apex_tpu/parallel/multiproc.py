@@ -1,0 +1,47 @@
+"""Multi-host launcher helper.
+
+Reference: ``apex/parallel/multiproc.py`` — a deprecated helper that spawned
+one training process per GPU. On TPU the per-chip process model is owned by
+the runtime: a single Python process drives all local chips, and multi-host
+SPMD is established with ``jax.distributed.initialize``. This module keeps
+the entry point for parity and wires it to the JAX runtime.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise multi-host JAX from args or the standard env variables
+    (``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``)."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        return  # single-host: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes or os.environ["NUM_PROCESSES"]),
+        process_id=int(process_id or os.environ["PROCESS_ID"]),
+    )
+
+
+def main() -> None:  # pragma: no cover
+    print(
+        "apex_tpu.parallel.multiproc: one process drives all local TPU chips; "
+        "use jax.distributed.initialize (or this module's "
+        "initialize_distributed) for multi-host.",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
