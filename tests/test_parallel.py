@@ -238,7 +238,7 @@ def test_convert_syncbn_model():
     converted = convert_syncbn_model(Outer(body=Net()), axis_name=None)
     assert isinstance(converted.body, nn.Module)
     # a bare BatchNorm converts to SyncBatchNorm and initialises fine
-    bn = convert_syncbn_model(nn.BatchNorm(use_running_average=False))
+    bn = convert_syncbn_model(nn.BatchNorm(use_running_average=False), axis_name=None)
     assert isinstance(bn, SyncBatchNorm)
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 5))
     variables = bn.init(jax.random.PRNGKey(1), x)
