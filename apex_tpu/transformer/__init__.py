@@ -1,0 +1,30 @@
+"""Megatron-style model-parallel transformer library, TPU-native.
+
+Reference: ``apex/transformer/__init__.py`` — exposes ``parallel_state``,
+``tensor_parallel``, ``pipeline_parallel``, fused ``functional`` ops, and an
+mp-aware amp. Here the process-group machinery is a ``jax.sharding.Mesh``
+and the kernels are Pallas/XLA.
+"""
+from . import parallel_state  # noqa: F401
+from . import tensor_parallel  # noqa: F401
+
+_LAZY = ("pipeline_parallel", "functional", "layers", "amp", "testing", "_data")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        try:
+            module = importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}"
+            ) from e
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_LAZY))

@@ -1,0 +1,57 @@
+"""Batch broadcast across the tensor-parallel group.
+
+Reference: ``apex/transformer/tensor_parallel/data.py:80-122`` —
+``broadcast_data(keys, data, datatype)`` sends the batch dict from TP rank 0
+to all TP ranks (sizes first, then one flattened buffer).
+
+TPU-native: under single-controller SPMD every device already sees the same
+host batch, so the broadcast is a no-op in the common case. The collective
+form is kept for shard_map regions where per-rank data may have diverged:
+a masked psum from tp rank 0 (the mesh spelling of an NCCL broadcast).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+
+
+def _in_traced_context(axis_name: str) -> bool:
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def broadcast_data(
+    keys: Sequence[str],
+    data: Dict[str, jax.Array],
+    datatype=None,
+    axis_name: Optional[str] = None,
+) -> Dict[str, jax.Array]:
+    """Return ``{k: data[k]}`` for ``k in keys``, identical across TP ranks.
+
+    Mirrors reference ``data.py:80-122``. Outside a traced region this is a
+    dict projection (data is already replicated); inside ``shard_map`` it
+    broadcasts rank 0's values via masked psum.
+    """
+    a = axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
+    out = {}
+    for k in keys:
+        v = data[k]
+        if datatype is not None:
+            v = v.astype(datatype)
+        out[k] = v
+    if not _in_traced_context(a):
+        return out
+    rank = jax.lax.axis_index(a)
+    return {
+        k: jax.lax.psum(
+            jnp.where(rank == 0, v, jnp.zeros_like(v)), a
+        ).astype(v.dtype)
+        for k, v in out.items()
+    }

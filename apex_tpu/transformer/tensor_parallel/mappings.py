@@ -1,0 +1,192 @@
+"""Tensor-parallel autograd collectives (+ sequence-parallel variants).
+
+Reference: ``apex/transformer/tensor_parallel/mappings.py`` — eight
+autograd.Functions pairing a forward collective with its backward dual:
+
+====================================================  =====================
+forward                                               backward
+====================================================  =====================
+copy       (identity)                 ``:141``        all-reduce
+reduce     (all-reduce)               ``:159``        identity
+scatter    (split last dim)           ``:177``        all-gather last dim
+gather     (all-gather last dim)      ``:195``        split last dim
+scatter_to_sequence_parallel  (split seq dim) ``:213``  all-gather seq dim
+gather_from_sequence_parallel (all-gather seq) ``:231``  reduce-scatter seq
+reduce_scatter_to_sequence_parallel   ``:253``        all-gather seq dim
+====================================================  =====================
+
+TPU-native: each is a ``jax.custom_vjp`` over ``jax.lax`` collectives
+(``psum`` / ``all_gather`` / ``psum_scatter`` / dynamic-slice split) bound to
+a named mesh axis, to be used inside ``shard_map``. The custom VJPs make the
+forward/backward pairing explicit rather than relying on collective
+transposition rules. Sequence-parallel functions operate on dim 0 (the
+``[s, b, h]`` Megatron layout); TP functions on the last dim.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
+
+
+def _split_along_dim(x: jax.Array, axis_name: str, dim: int) -> jax.Array:
+    """Keep this rank's 1/world slice of ``x`` along ``dim``
+    (reference ``mappings.py:63-80`` ``_split_along_last_dim``)."""
+    world = jax.lax.axis_size(axis_name)  # static
+    rank = jax.lax.axis_index(axis_name)
+    # divisibility guard (reference utils.py ensure_divisibility)
+    if x.shape[dim] % world != 0:
+        raise ValueError(
+            f"dimension {dim} of shape {x.shape} is not divisible by "
+            f"axis {axis_name!r} size {world}"
+        )
+    size = x.shape[dim] // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * size, size, axis=dim)
+
+
+def _all_gather_dim(x: jax.Array, axis_name: str, dim: int) -> jax.Array:
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter_dim(x: jax.Array, axis_name: str, dim: int) -> jax.Array:
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+# --- copy: identity fwd / all-reduce bwd (mappings.py:141) -------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, _axis(axis_name)),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# --- reduce: all-reduce fwd / identity bwd (mappings.py:159) -----------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    return jax.lax.psum(x, _axis(axis_name))
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, _axis(axis_name)), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --- scatter: split-last-dim fwd / all-gather bwd (mappings.py:177) ----------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    return _split_along_dim(x, _axis(axis_name), x.ndim - 1)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along_dim(x, _axis(axis_name), x.ndim - 1), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, _axis(axis_name), g.ndim - 1),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# --- gather: all-gather-last-dim fwd / split bwd (mappings.py:195) -----------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    return _all_gather_dim(x, _axis(axis_name), x.ndim - 1)
+
+
+def _gather_fwd(x, axis_name):
+    return _all_gather_dim(x, _axis(axis_name), x.ndim - 1), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_along_dim(g, _axis(axis_name), g.ndim - 1),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --- sequence-parallel collectives (dim 0 of [s, b, h]) ----------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
+    """Split along the sequence dim (reference ``mappings.py:213-228``)."""
+    return _split_along_dim(x, _axis(axis_name), 0)
+
+
+def _seq_scatter_fwd(x, axis_name):
+    return _split_along_dim(x, _axis(axis_name), 0), None
+
+
+def _seq_scatter_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, _axis(axis_name), 0),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, axis_name: Optional[str] = None, to_model_parallel: bool = True
+):
+    """All-gather along sequence dim; backward reduce-scatters (the SP
+    linear-layer pairing, reference ``mappings.py:231-250``) or plain-splits
+    when ``to_model_parallel=False`` (embedding path)."""
+    return _all_gather_dim(x, _axis(axis_name), 0)
+
+
+def _seq_gather_fwd(x, axis_name, to_model_parallel):
+    return _all_gather_dim(x, _axis(axis_name), 0), None
+
+
+def _seq_gather_bwd(axis_name, to_model_parallel, _, g):
+    a = _axis(axis_name)
+    if to_model_parallel:
+        return (_reduce_scatter_dim(g, a, 0),)
+    return (_split_along_dim(g, a, 0),)
+
+
+gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
+    """Reduce-scatter along sequence dim (reference ``mappings.py:253-268``)."""
+    return _reduce_scatter_dim(x, _axis(axis_name), 0)
+
+
+def _seq_rs_fwd(x, axis_name):
+    return _reduce_scatter_dim(x, _axis(axis_name), 0), None
+
+
+def _seq_rs_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, _axis(axis_name), 0),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

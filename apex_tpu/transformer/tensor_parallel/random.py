@@ -1,0 +1,135 @@
+"""Model-parallel RNG state tracking + activation checkpointing.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` (``:124-201``) keeps named CUDA RNG states so
+dropout inside TP regions draws *different* randomness per TP rank while
+non-parallel regions stay identical across ranks;
+``model_parallel_cuda_manual_seed`` (``:204-235``) seeds the
+``model-parallel-rng`` state with ``seed + 2718 + tp_rank``; and
+``CheckpointFunction``/``checkpoint`` (``:237-311``) re-run the forward in
+backward with exact RNG replay.
+
+TPU-native: JAX PRNG keys are values, not device state, so "tracking" is a
+named registry of keys. Per-rank divergence is a ``fold_in`` of the traced
+TP ``axis_index`` — deterministic and replayable by construction (no
+state-save/restore dance). Activation checkpointing maps to
+``jax.checkpoint``, whose rematerialisation replays the same key-derived
+randomness exactly — the property ``CheckpointFunction`` implements by hand.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+
+# Named key registry (reference's _CUDA_RNG_STATE_TRACKER).
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RngStateTracker:
+    """Named PRNG-key registry (reference ``CudaRNGStatesTracker``
+    ``random.py:124-201``). ``fork(name)`` yields a fresh subkey and advances
+    the stored state, so successive forks of the same name draw distinct
+    randomness — the functional analogue of forking CUDA RNG state."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_: set = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self.states_)
+
+    def set_states(self, states: Dict[str, jax.Array]) -> None:
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int) -> None:
+        if seed in self.seeds_:
+            raise Exception(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a subkey for ``name`` and advance the stored state
+        (reference ``random.py:180-201``)."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        key, next_state = jax.random.split(self.states_[name])
+        self.states_[name] = next_state
+        yield key
+
+
+_RNG_STATE_TRACKER = RngStateTracker()
+
+
+def get_rng_state_tracker() -> RngStateTracker:
+    """Reference ``get_cuda_rng_tracker`` (``random.py:204-206``)."""
+    return _RNG_STATE_TRACKER
+
+
+# torch-name alias for parity
+get_cuda_rng_tracker = get_rng_state_tracker
+
+
+def model_parallel_manual_seed(seed: int) -> None:
+    """Seed the default and model-parallel RNG streams.
+
+    Reference ``model_parallel_cuda_manual_seed`` (``random.py:204-235``):
+    default stream gets ``seed``; the TP stream gets ``seed + 2718``
+    (per-rank divergence is folded in at use time — see
+    :func:`model_parallel_rng_key` — because a single SPMD controller has no
+    host-side TP rank)."""
+    offset = seed + 2718
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("default", seed)
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, offset)
+
+
+# torch-name alias for parity
+model_parallel_cuda_manual_seed = model_parallel_manual_seed
+
+
+def model_parallel_rng_key(key: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
+    """Diverge ``key`` per TP rank (the ``+ tensor_model_parallel_rank`` of
+    reference ``random.py:222``): ``fold_in`` of the traced axis index. Call
+    inside shard_map for TP-region dropout."""
+    a = axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
+    return jax.random.fold_in(key, jax.lax.axis_index(a))
+
+
+# --------------------------------------------------------------------------
+# Activation checkpointing (reference random.py:237-311)
+# --------------------------------------------------------------------------
+
+def checkpoint(function, distribute_saved_activations: bool = False, *args):
+    """Activation-checkpointed call of ``function(*args)``.
+
+    Reference ``checkpoint`` (``random.py:303-311``) wraps
+    ``CheckpointFunction``, which stashes RNG state and replays it when
+    re-running forward during backward. ``jax.checkpoint`` gives the same
+    recompute-in-backward with *automatic* exact RNG replay (keys are
+    values). ``distribute_saved_activations`` (partitioned activation
+    buffers, reference ``:48-87``) maps to sharding the saved residuals —
+    on TPU use sequence/tensor sharding constraints instead; the flag is
+    accepted and ignored.
+    """
+    del distribute_saved_activations
+    return jax.checkpoint(function)(*args)
+
+
+class CheckpointFunction:
+    """API-parity shim for reference ``CheckpointFunction`` (``random.py:237``)."""
+
+    @staticmethod
+    def apply(function, distribute_saved_activations, *args):
+        return checkpoint(function, distribute_saved_activations, *args)

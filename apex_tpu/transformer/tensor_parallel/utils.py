@@ -1,0 +1,48 @@
+"""TP utilities (reference ``apex/transformer/tensor_parallel/utils.py``)."""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    """Reference ``utils.py:10-13``."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Reference ``utils.py:16-19``."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(
+    tensor: jax.Array, num_partitions: int, contiguous_split_chunks: bool = False
+) -> Tuple[jax.Array, ...]:
+    """Reference ``utils.py:22-43``. ``contiguous_split_chunks`` is moot on
+    XLA (layouts are compiler-owned); accepted for parity."""
+    del contiguous_split_chunks
+    divide(tensor.shape[-1], num_partitions)
+    return tuple(jnp.split(tensor, num_partitions, axis=-1))
+
+
+class VocabUtility:
+    """Vocab range bookkeeping for vocab-parallel embeddings/logits
+    (reference ``utils.py:46-64``). Works with traced ranks."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ):
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank, world_size: int):
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world_size
+        )

@@ -1,0 +1,345 @@
+"""Tensor-parallel tests: mappings, layers, cross entropy, RNG, data, memory.
+
+Mirrors the reference's run_transformer distributed suites
+(``tests/L0/run_transformer/test_{mapping,layers,cross_entropy,random,data}.py``)
+on the 8-virtual-device CPU mesh: collective fwd/bwd duality, TP layers vs
+dense single-device equivalence, vocab-parallel CE vs plain CE.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel as tp
+
+shard_map = jax.shard_map
+
+TP = 8
+
+
+@pytest.fixture(autouse=True)
+def _init_parallel():
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def _mesh():
+    return parallel_state.get_mesh()
+
+
+def _smap(f, in_specs, out_specs):
+    return shard_map(
+        f, mesh=_mesh(), in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+
+
+def test_parallel_state_sizes():
+    assert parallel_state.get_tensor_model_parallel_world_size() == TP
+    assert parallel_state.get_pipeline_model_parallel_world_size() == 1
+    assert parallel_state.get_data_parallel_world_size() == 1
+    assert parallel_state.model_parallel_is_initialized()
+    # trivial axes give static rank 0
+    assert parallel_state.get_pipeline_model_parallel_rank() == 0
+    assert parallel_state.is_pipeline_first_stage()
+    assert parallel_state.is_pipeline_last_stage()
+
+
+def test_parallel_state_split_rank():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=1,
+        pipeline_model_parallel_size_=4,
+        pipeline_model_parallel_split_rank_=2,
+    )
+    assert parallel_state.get_pipeline_model_parallel_split_rank() == 2
+    assert parallel_state.get_data_parallel_world_size() == 2
+
+
+# --- mappings fwd/bwd duality (reference test_mapping.py) --------------------
+
+def test_copy_region_fwd_identity_bwd_allreduce():
+    x = jax.random.normal(jax.random.PRNGKey(0), (TP, 4))
+
+    def f(xs):
+        y = tp.copy_to_tensor_model_parallel_region(xs, "tensor")
+        return y
+
+    out = _smap(f, P("tensor", None), P("tensor", None))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    # bwd: grad of sum(f(x)*c) wrt x is psum(c) per shard
+    def g(xs):
+        return jnp.sum(tp.copy_to_tensor_model_parallel_region(xs, "tensor"))
+
+    grads = _smap(jax.grad(g), P("tensor", None), P("tensor", None))(x)
+    np.testing.assert_allclose(np.asarray(grads), TP * 1.0)
+
+
+def test_reduce_region_fwd_allreduce():
+    x = jnp.ones((TP, 3))
+    out = _smap(
+        lambda xs: tp.reduce_from_tensor_model_parallel_region(xs, "tensor"),
+        P("tensor", None), P("tensor", None),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), TP)
+
+
+def test_scatter_gather_roundtrip():
+    full = jax.random.normal(jax.random.PRNGKey(1), (4, TP * 5))
+
+    def f(x_rep):
+        local = tp.scatter_to_tensor_model_parallel_region(x_rep, "tensor")
+        assert local.shape == (4, 5)
+        return tp.gather_from_tensor_model_parallel_region(local, "tensor")
+
+    out = _smap(f, P(), P())(full)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full))
+
+
+def test_sequence_parallel_roundtrip_and_reduce_scatter():
+    seq = TP * 3
+    full = jax.random.normal(jax.random.PRNGKey(2), (seq, 2, 4))
+
+    def f(x_rep):
+        local = tp.scatter_to_sequence_parallel_region(x_rep, "tensor")
+        assert local.shape == (3, 2, 4)
+        return tp.gather_from_sequence_parallel_region(local, "tensor", True)
+
+    out = _smap(f, P(), P())(full)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full))
+
+    # reduce_scatter: each shard ends with the summed slice
+    def g(x_rep):
+        return tp.reduce_scatter_to_sequence_parallel_region(x_rep, "tensor")
+
+    rs = _smap(g, P(), P("tensor", None, None))(full)
+    np.testing.assert_allclose(np.asarray(rs), TP * np.asarray(full), rtol=1e-6)
+
+
+# --- TP linears vs dense (reference test_layers.py) --------------------------
+
+def test_column_parallel_linear_matches_dense():
+    key = jax.random.PRNGKey(3)
+    in_f, out_f = 12, TP * 4
+    x = jax.random.normal(key, (6, in_f))
+    w_full = jax.random.normal(jax.random.PRNGKey(4), (out_f, in_f)) * 0.1
+    b_full = jax.random.normal(jax.random.PRNGKey(5), (out_f,)) * 0.1
+
+    def f(x_rep, w_shard, b_shard):
+        out, _ = tp.column_parallel_linear(
+            x_rep, w_shard, b_shard, axis_name="tensor", gather_output=True
+        )
+        return out
+
+    out = _smap(
+        f, (P(), P("tensor", None), P("tensor")), P()
+    )(x, w_full, b_full)
+    ref = x @ w_full.T + b_full
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_row_parallel_linear_matches_dense():
+    in_f, out_f = TP * 4, 6
+    x = jax.random.normal(jax.random.PRNGKey(6), (5, in_f))
+    w_full = jax.random.normal(jax.random.PRNGKey(7), (out_f, in_f)) * 0.1
+    b = jax.random.normal(jax.random.PRNGKey(8), (out_f,)) * 0.1
+
+    def f(x_rep, w_shard, b_rep):
+        out, _ = tp.row_parallel_linear(
+            x_rep, w_shard, b_rep, axis_name="tensor", input_is_parallel=False
+        )
+        return out
+
+    out = _smap(f, (P(), P(None, "tensor"), P()), P())(x, w_full, b)
+    ref = x @ w_full.T + b
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_column_row_pair_backward_matches_dense():
+    """MLP block: column(gather=False) -> row(input_is_parallel): fwd + grads
+    must equal the dense computation (reference test_layers.py idiom)."""
+    in_f, hid, out_f = 8, TP * 4, 8
+    x = jax.random.normal(jax.random.PRNGKey(9), (3, in_f))
+    w1 = jax.random.normal(jax.random.PRNGKey(10), (hid, in_f)) * 0.2
+    w2 = jax.random.normal(jax.random.PRNGKey(11), (out_f, hid)) * 0.2
+
+    def dense_loss(x, w1, w2):
+        h = jax.nn.gelu(x @ w1.T)
+        return jnp.sum((h @ w2.T) ** 2)
+
+    def tp_loss(x_rep, w1_s, w2_s):
+        h, _ = tp.column_parallel_linear(
+            x_rep, w1_s, None, axis_name="tensor", gather_output=False
+        )
+        h = jax.nn.gelu(h)
+        y, _ = tp.row_parallel_linear(
+            h, w2_s, None, axis_name="tensor", input_is_parallel=True
+        )
+        return jnp.sum(y**2) / TP  # replicated loss summed by psum in grads? no:
+        # loss is identical on every shard; grad wrt replicated x arrives
+        # synced through the copy-region backward allreduce.
+
+    grads_tp = _smap(
+        jax.grad(tp_loss, argnums=(0, 1, 2)),
+        (P(), P("tensor", None), P(None, "tensor")),
+        (P(), P("tensor", None), P(None, "tensor")),
+    )(x, w1, w2)
+    gx_tp, gw1_tp, gw2_tp = [np.asarray(g) for g in grads_tp]
+
+    gx, gw1, gw2 = [
+        np.asarray(g) for g in jax.grad(dense_loss, argnums=(0, 1, 2))(x, w1, w2)
+    ]
+    np.testing.assert_allclose(gx_tp * TP, gx, atol=2e-4)
+    np.testing.assert_allclose(gw1_tp * TP, gw1, atol=2e-4)
+    np.testing.assert_allclose(gw2_tp * TP, gw2, atol=2e-4)
+
+
+def test_vocab_parallel_embedding_matches_dense():
+    vocab, hidden = TP * 6, 5
+    ids = jnp.array([[0, 3, 17, 47], [5, 46, 23, 11]])
+    table = jax.random.normal(jax.random.PRNGKey(12), (vocab, hidden))
+
+    out = _smap(
+        lambda i, t: tp.vocab_parallel_embedding(i, t, axis_name="tensor"),
+        (P(), P("tensor", None)), P(),
+    )(ids, table)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(table)[np.asarray(ids)], atol=1e-6
+    )
+
+
+# --- vocab-parallel CE (reference test_cross_entropy.py) ---------------------
+
+@pytest.mark.parametrize("label_smoothing", [0.0, 0.1])
+def test_vocab_parallel_cross_entropy_matches_dense(label_smoothing):
+    vocab = TP * 8
+    logits = jax.random.normal(jax.random.PRNGKey(13), (4, 7, vocab)) * 2
+    targets = jax.random.randint(jax.random.PRNGKey(14), (4, 7), 0, vocab)
+
+    loss_tp = _smap(
+        lambda lg, t: tp.vocab_parallel_cross_entropy(
+            lg, t, label_smoothing, "tensor"
+        ),
+        (P(None, None, "tensor"), P()), P(),
+    )(logits, targets)
+
+    # dense reference with the same smoothing formula
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    if label_smoothing > 0:
+        smoothing = label_smoothing * vocab / (vocab - 1)
+        ref = (1 - smoothing) * nll - smoothing * jnp.mean(logp, -1)
+    else:
+        ref = nll
+    np.testing.assert_allclose(np.asarray(loss_tp), np.asarray(ref), atol=1e-5)
+
+
+def test_vocab_parallel_cross_entropy_grad():
+    vocab = TP * 4
+    logits = jax.random.normal(jax.random.PRNGKey(15), (3, vocab))
+    targets = jnp.array([1, 17, 30])
+
+    # check_vma=True: JAX tracks replication through the psums so the
+    # replicated loss back-propagates exactly once into the sharded logits.
+    g_tp = shard_map(
+        jax.grad(
+            lambda lg, t: jnp.sum(
+                tp.vocab_parallel_cross_entropy(lg, t, 0.0, "tensor")
+            )
+        ),
+        mesh=_mesh(), in_specs=(P(None, "tensor"), P()),
+        out_specs=P(None, "tensor"), check_vma=True,
+    )(logits, targets)
+
+    g_ref = jax.grad(
+        lambda lg: jnp.sum(
+            -jnp.take_along_axis(jax.nn.log_softmax(lg), targets[..., None], -1)
+        )
+    )(logits)
+    np.testing.assert_allclose(np.asarray(g_tp), np.asarray(g_ref), atol=1e-5)
+
+
+# --- RNG tracker (reference test_random.py) ----------------------------------
+
+def test_rng_tracker_fork_and_seed():
+    tp.model_parallel_manual_seed(123)
+    tracker = tp.get_rng_state_tracker()
+    with tracker.fork() as k1:
+        pass
+    with tracker.fork() as k2:
+        pass
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    with pytest.raises(Exception):
+        tracker.add("model-parallel-rng", 99)  # duplicate name
+    with pytest.raises(Exception):
+        tracker.fork("missing").__enter__()
+
+
+def test_model_parallel_rng_key_diverges_per_rank():
+    tp.model_parallel_manual_seed(7)
+    base = jax.random.PRNGKey(7 + 2718)
+
+    def f(_):
+        k = tp.model_parallel_rng_key(base, "tensor")
+        return jax.random.normal(k, (1, 4))
+
+    out = np.asarray(
+        _smap(f, P("tensor", None), P("tensor", None))(jnp.zeros((TP, 1)))
+    )
+    # every rank drew different randomness
+    assert len({tuple(np.round(r, 6)) for r in out}) == TP
+
+
+def test_checkpoint_matches_plain():
+    def f(x):
+        return jnp.sum(jnp.tanh(x) ** 2)
+
+    x = jax.random.normal(jax.random.PRNGKey(16), (8,))
+    assert np.allclose(
+        tp.checkpoint(f, False, x), f(x)
+    )
+    g1 = jax.grad(lambda x: tp.checkpoint(f, False, x))(x)
+    g2 = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+
+
+# --- data + memory ----------------------------------------------------------
+
+def test_broadcast_data_host_and_traced():
+    data = {"text": jnp.arange(6).reshape(2, 3), "mask": jnp.ones((2, 3))}
+    out = tp.broadcast_data(["text"], data, jnp.int32)
+    assert set(out) == {"text"} and out["text"].dtype == jnp.int32
+
+    def f(x):
+        rank = jax.lax.axis_index("tensor")
+        local = {"v": x + rank.astype(x.dtype)}  # diverged per rank
+        return tp.broadcast_data(["v"], local, axis_name="tensor")["v"]
+
+    out2 = _smap(f, P(), P("tensor", None))(jnp.zeros((1, 2)))
+    np.testing.assert_allclose(np.asarray(out2), 0.0)  # rank-0 value everywhere
+
+
+def test_memory_buffer():
+    buf = tp.MemoryBuffer("test", 32, jnp.float32, track_usage=True)
+    t = buf.get((4, 4), 0)
+    assert t.shape == (4, 4)
+    with pytest.raises(ValueError):
+        buf.get((33,), 0)
+    ring = tp.RingMemBuffer("ring", 2, 16, jnp.float32)
+    b1, b2, b3 = (ring.get_next_buffer() for _ in range(3))
+    assert b1 is b3 and b1 is not b2
+
+
+def test_utils():
+    with pytest.raises(ValueError):
+        tp.ensure_divisibility(7, 2)
+    assert tp.divide(12, 4) == 3
+    parts = tp.split_tensor_along_last_dim(jnp.ones((2, 8)), 4)
+    assert len(parts) == 4 and parts[0].shape == (2, 2)
+    first, last = tp.VocabUtility.vocab_range_from_global_vocab_size(64, 3, 8)
+    assert (first, last) == (24, 32)
