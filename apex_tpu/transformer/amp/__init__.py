@@ -1,0 +1,2 @@
+"""Model-parallel-aware amp (reference ``apex/transformer/amp/__init__.py``)."""
+from .grad_scaler import GradScaler  # noqa: F401

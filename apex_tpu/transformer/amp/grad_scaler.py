@@ -1,0 +1,72 @@
+"""Model-parallel-aware gradient scaler.
+
+Reference: ``apex/transformer/amp/grad_scaler.py:21-125`` — a
+``torch.cuda.amp.GradScaler`` subclass whose only delta is all-reducing
+``found_inf`` (MAX) across the **model-parallel group** before the step and
+inside ``update``, so a TP/PP shard that overflowed makes *every* shard skip
+the step.
+
+TPU-native: wraps ``apex_tpu.amp.LossScaler`` and ORs the finite flag over
+the model-parallel mesh axes with ``jax.lax.pmax`` before the skip-step
+``lax.cond``. Use inside shard_map regions binding the tensor/pipeline axes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...amp.scaler import LossScaleState, LossScaler
+from .. import parallel_state
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose overflow flag is agreed across model-parallel axes.
+
+    ``model_parallel_axes`` defaults to (tensor, pipeline) — the reference's
+    model-parallel group (``grad_scaler.py:48-60``).
+    """
+
+    def __init__(
+        self,
+        *args,
+        model_parallel_axes: Sequence[str] = (
+            parallel_state.TENSOR_AXIS,
+            parallel_state.PIPELINE_AXIS,
+        ),
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def _allreduce_found_inf(self, found_inf: jax.Array) -> jax.Array:
+        """MAX-reduce the overflow flag over every bound model-parallel axis
+        (reference ``grad_scaler.py:63-91``)."""
+        f = found_inf.astype(jnp.float32)
+        for a in self.model_parallel_axes:
+            try:
+                f = jax.lax.pmax(f, a)
+            except NameError:
+                continue  # axis not bound in this region
+        return f > 0
+
+    def unscale(self, state: LossScaleState, grads, out_dtype=None):
+        grads, new_state = super().unscale(state, grads, out_dtype)
+        return grads, new_state._replace(
+            found_inf=self._allreduce_found_inf(new_state.found_inf)
+        )
+
+    def unscale_with_stashed(self, state, new_scaled_grads, stashed_grads):
+        grads, new_state = super().unscale_with_stashed(
+            state, new_scaled_grads, stashed_grads
+        )
+        return grads, new_state._replace(
+            found_inf=self._allreduce_found_inf(new_state.found_inf)
+        )
+
+    def update_scale(self, state: LossScaleState) -> LossScaleState:
+        synced = state._replace(
+            found_inf=self._allreduce_found_inf(state.found_inf)
+        )
+        return super().update_scale(synced)

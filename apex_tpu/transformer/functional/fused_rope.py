@@ -1,0 +1,113 @@
+"""Fused rotary positional embeddings in sbhd / cached / thd / 2d layouts.
+
+Reference: ``apex/transformer/functional/fused_rope.py`` +
+``csrc/megatron/fused_rotary_positional_embedding.{h,_cuda.cu}`` — 8 CUDA
+ops applying NeoX-style rotate-half RoPE:
+
+    out[d] = t[d]·cos(f[s,d]) + rot(t)[d]·sin(f[s,d]),   d < d2
+    rot(t)[d] = -t[d + d2/2]  if d < d2/2  else  t[d - d2/2]
+    out[d] = t[d]                                         d ≥ d2  (pass-through)
+
+in four layouts: ``sbhd`` [s,b,h,d] with freqs [s,1,1,d2]; cached cos/sin;
+``thd`` packed varlen (positions restart at each ``cu_seqlens`` boundary);
+and 2d image RoPE (height freqs on the first half of the head dim, width
+freqs on the second).
+
+TPU-native: pure elementwise ops — the CUDA kernels exist to fuse the
+sincos + gather + rotate into one launch, which XLA does automatically once
+traced. No Pallas and no hand-written VJPs: autodiff produces the CUDA
+``fused_rope_block_backward`` rotation for ``t`` *and* correct gradients
+for ``freqs``/``cos``/``sin`` (which the reference's backward silently
+drops — its autograd.Function returns None for them).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _rotate_half(t: jax.Array) -> jax.Array:
+    """NeoX rotate-half: [-x2, x1] for t split into halves on the last dim
+    (``fused_rotary_positional_embedding.h:43-46``)."""
+    d2 = t.shape[-1]
+    x1, x2 = t[..., : d2 // 2], t[..., d2 // 2 :]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _apply_rope(t, cos, sin):
+    """Apply rope to the first ``d2 = cos.shape[-1]`` dims, pass-through rest."""
+    d, d2 = t.shape[-1], cos.shape[-1]
+    t_rope = t[..., :d2]
+    out = (
+        t_rope.astype(jnp.float32) * cos
+        + _rotate_half(t_rope).astype(jnp.float32) * sin
+    ).astype(t.dtype)
+    if d > d2:
+        out = jnp.concatenate([out, t[..., d2:]], axis=-1)
+    return out
+
+
+# --- sbhd (reference FusedRoPEFunc, fused_rope.py:19-81) ---------------------
+
+def fused_apply_rotary_pos_emb(t: jax.Array, freqs: jax.Array) -> jax.Array:
+    """RoPE on ``t`` [s, b, h, d] with ``freqs`` [s, 1, 1, d2] (float).
+
+    ``transpose_output_memory`` from the reference is a CUDA memory-format
+    knob with no XLA analogue (layouts are compiler-assigned) and is omitted.
+    """
+    return _apply_rope(t, jnp.cos(freqs), jnp.sin(freqs))
+
+
+# --- cached cos/sin (reference FusedRoPECachedFunc, fused_rope.py:84-150) ----
+
+def fused_apply_rotary_pos_emb_cached(
+    t: jax.Array, cos_: jax.Array, sin_: jax.Array
+) -> jax.Array:
+    """RoPE on ``t`` [s, b, h, d] with precomputed ``cos_``/``sin_``
+    [s, 1, 1, d2]."""
+    return _apply_rope(t, cos_.astype(jnp.float32), sin_.astype(jnp.float32))
+
+
+# --- thd packed varlen (reference FusedRoPETHDFunc, fused_rope.py:153-211) ---
+
+def fused_apply_rotary_pos_emb_thd(
+    t: jax.Array, cu_seqlens: jax.Array, freqs: jax.Array
+) -> jax.Array:
+    """RoPE on packed ``t`` [total_tokens, h, d] where positions restart at
+    every ``cu_seqlens`` boundary (cu_seqlens [b+1], cumulative lengths).
+
+    Per-token position = token_index − cu_seqlens[seq_of(token)], resolved
+    with a searchsorted instead of the CUDA kernel's per-sequence grid.
+    """
+    tok = jnp.arange(t.shape[0])
+    seq_id = jnp.searchsorted(cu_seqlens, tok, side="right") - 1
+    pos = tok - cu_seqlens[seq_id]
+    f = freqs.reshape(freqs.shape[0], -1)[pos]  # [total, d2]
+    return _apply_rope(t, jnp.cos(f)[:, None, :], jnp.sin(f)[:, None, :])
+
+
+# --- 2d image rope (reference FusedRoPE2DFunc, fused_rope.py:214-305) --------
+
+def fused_apply_rotary_pos_emb_2d(
+    t: jax.Array,
+    img_h: int,
+    img_w: int,
+    cos_h: jax.Array,
+    sin_h: jax.Array,
+    cos_w: jax.Array,
+    sin_w: jax.Array,
+) -> jax.Array:
+    """2D RoPE on ``t`` [b, s, h, d] with ``s == img_h * img_w``:
+    height-axis freqs rotate the first d/2 of the head dim, width-axis freqs
+    the second (cos/sin_h [1, H≥img_h, 1, d//2], cos/sin_w [1, W≥img_w, 1, d//2])."""
+    b, s, h, d = t.shape
+    assert s == img_h * img_w, "sequence length must equal img_h * img_w"
+    x = t.reshape(b, img_h, img_w, h, d)
+    first, second = x[..., : d // 2], x[..., d // 2 :]
+    ch = cos_h[:, :img_h, None, :, :].astype(jnp.float32)  # [1,img_h,1,1,d//2]
+    sh = sin_h[:, :img_h, None, :, :].astype(jnp.float32)
+    cw = cos_w[:, None, :img_w, :, :].astype(jnp.float32)  # [1,1,img_w,1,d//2]
+    sw = sin_w[:, None, :img_w, :, :].astype(jnp.float32)
+    out_first = _apply_rope(first, ch, sh)
+    out_second = _apply_rope(second, cw, sw)
+    return jnp.concatenate([out_first, out_second], -1).reshape(b, s, h, d)

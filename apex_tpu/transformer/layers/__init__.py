@@ -1,0 +1,3 @@
+"""Transformer layer-norm wrappers (reference
+``apex/transformer/layers/__init__.py``)."""
+from .layer_norm import FastLayerNorm, FusedLayerNorm, MixedFusedLayerNorm  # noqa: F401

@@ -1,0 +1,49 @@
+"""Sequence-parallel-aware LayerNorm wrappers.
+
+Reference: ``apex/transformer/layers/layer_norm.py:26-99`` — thin subclasses
+of the fused LayerNorms whose only job is to tag ``weight``/``bias`` with a
+``sequence_parallel_enabled`` attribute, which the Megatron grad-sync loop
+reads to all-reduce those grads across the TP group (under SP, layernorm
+params are replicated while activations are sequence-sharded).
+
+TPU-native: flax params carry no attributes, so the tag lives on the module
+and is exported via ``sequence_parallel_param_names`` — the grad-sync
+transform (``pipeline_parallel.utils.allreduce_sequence_parallel_grads``)
+matches parameter paths against these names. ``FastLayerNorm`` (the contrib
+persistent kernel) maps to the same Pallas kernel; it exists as a separate
+name for API parity.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from ...normalization import FusedLayerNorm as _BaseFusedLayerNorm
+from ...normalization import MixedFusedLayerNorm as _BaseMixedFusedLayerNorm
+
+Shape = Union[int, Sequence[int]]
+
+
+class FusedLayerNorm(_BaseFusedLayerNorm):
+    """Reference ``layers/layer_norm.py:26-55``."""
+
+    sequence_parallel_enabled: bool = False
+
+    @property
+    def sequence_parallel_param_names(self):
+        return ("scale", "bias") if self.sequence_parallel_enabled else ()
+
+
+class MixedFusedLayerNorm(_BaseMixedFusedLayerNorm):
+    """Reference ``layers/layer_norm.py:58-77``."""
+
+    sequence_parallel_enabled: bool = False
+
+    @property
+    def sequence_parallel_param_names(self):
+        return ("scale", "bias") if self.sequence_parallel_enabled else ()
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """Reference ``layers/layer_norm.py:80-99`` wraps the contrib
+    ``fast_layer_norm`` persistent kernel; on TPU the same Pallas kernel
+    serves all hidden sizes, so this is an alias with the SP tag."""

@@ -1,0 +1,85 @@
+"""Megatron-style timers.
+
+Reference: ``apex/transformer/pipeline_parallel/_timers.py:6-83`` —
+``_Timer`` with ``torch.cuda.synchronize`` around start/stop and ``Timers``
+with rank-0 logging. TPU equivalent: ``jax.block_until_ready`` fences
+(callers pass the arrays to fence on) + ``jax.profiler`` named traces.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+class _Timer:
+    """Reference ``_timers.py:6-49``."""
+
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self, barrier_on=None) -> None:
+        if self.started_:
+            raise RuntimeError("timer has already been started")
+        if barrier_on is not None:
+            jax.block_until_ready(barrier_on)
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, barrier_on=None) -> None:
+        if not self.started_:
+            raise RuntimeError("timer is not started")
+        if barrier_on is not None:
+            jax.block_until_ready(barrier_on)
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self) -> None:
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        elapsed = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return elapsed
+
+
+class Timers:
+    """Reference ``_timers.py:52-83``."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        assert normalizer > 0.0
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(f"{name}-time", value, iteration)
+
+    def log(self, names=None, normalizer=1.0, reset=True) -> str:
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers)
+        string = "time (ms)"
+        for name in names:
+            elapsed_time = (
+                self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            )
+            string += f" | {name}: {elapsed_time:.2f}"
+        if jax.process_index() == jax.process_count() - 1:
+            print(string, flush=True)
+        return string

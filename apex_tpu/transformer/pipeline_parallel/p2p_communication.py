@@ -1,0 +1,119 @@
+"""Stage-to-stage tensor exchange for pipeline parallelism.
+
+Reference: ``apex/transformer/pipeline_parallel/p2p_communication.py`` —
+``_communicate`` (``:168``) builds paired ``P2POp`` send/recv lists and
+issues ``batch_isend_irecv`` between pipeline neighbours, with
+scatter-gather of activations over TP ranks, async ``FutureTensor``
+returns, and SP-aware shapes; public API ``recv_forward`` /
+``send_forward`` / ``send_forward_recv_backward`` / … (``:385-690``).
+
+TPU-native: a point-to-point hop between pipeline stages is a
+``jax.lax.ppermute`` over the ``pipeline`` mesh axis — one collective in
+which every stage simultaneously sends to its neighbour and receives from
+the other, executed on ICI. Consequences:
+
+- "send" and "recv" are the *same* op: ``send_forward`` returns the tensor
+  this stage received from its predecessor (what the reference splits into
+  ``send_forward``+``recv_forward`` pairs);
+- the paired ops (``send_forward_recv_backward`` etc.) are two ppermutes in
+  opposite directions, which XLA schedules concurrently;
+- ``async_comm``/``FutureTensor`` disappear — XLA's latency-hiding
+  scheduler overlaps the permute with compute;
+- the scatter-gather optimisation (split activation over TP before send,
+  ``:231-330``) is a sharding annotation: keep activations TP/SP-sharded and
+  the permute moves only the local shard.
+
+All functions must be called inside ``shard_map`` binding the pipeline axis.
+Non-participating edge stages receive the wrap-around value; schedules mask
+it (the reference instead skips the op on edge ranks — impossible in SPMD,
+where every device executes the same collective).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+
+Pytree = Any
+
+
+def _perm(axis_name: str, shift: int):
+    n = jax.lax.axis_size(axis_name)
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
+
+
+def send_forward(output_tensor: Pytree, axis_name: Optional[str] = None) -> Pytree:
+    """Rotate activations one stage forward; returns what this stage received
+    from its predecessor (reference ``send_forward`` ``:508`` +
+    ``recv_forward`` ``:385`` fused into the single SPMD collective)."""
+    a = _axis(axis_name)
+    return jax.tree_util.tree_map(
+        lambda t: jax.lax.ppermute(t, a, _perm(a, +1)), output_tensor
+    )
+
+
+def send_backward(input_tensor_grad: Pytree, axis_name: Optional[str] = None) -> Pytree:
+    """Rotate gradients one stage backward (reference ``send_backward``
+    ``:547`` + ``recv_backward`` ``:434``)."""
+    a = _axis(axis_name)
+    return jax.tree_util.tree_map(
+        lambda t: jax.lax.ppermute(t, a, _perm(a, -1)), input_tensor_grad
+    )
+
+
+# The reference's recv-only calls: in SPMD they are the same rotation, named
+# for call-site parity.
+recv_forward = send_forward
+recv_backward = send_backward
+
+
+def send_forward_recv_backward(
+    output_tensor: Pytree, input_tensor_grad: Pytree,
+    axis_name: Optional[str] = None,
+):
+    """Two opposite-direction rotations (reference ``:585-610``); XLA runs
+    them concurrently. Returns (recv_from_prev, recv_from_next)."""
+    return send_forward(output_tensor, axis_name), send_backward(
+        input_tensor_grad, axis_name
+    )
+
+
+def send_backward_recv_forward(
+    input_tensor_grad: Pytree, output_tensor: Pytree,
+    axis_name: Optional[str] = None,
+):
+    """Reference ``:613-638``. Returns (recv_from_next, recv_from_prev)."""
+    return send_backward(input_tensor_grad, axis_name), send_forward(
+        output_tensor, axis_name
+    )
+
+
+def send_forward_recv_forward(
+    output_tensor: Pytree, axis_name: Optional[str] = None
+) -> Pytree:
+    """Reference ``:641-664`` — identical to :func:`send_forward` in SPMD."""
+    return send_forward(output_tensor, axis_name)
+
+
+def send_backward_recv_backward(
+    input_tensor_grad: Pytree, axis_name: Optional[str] = None
+) -> Pytree:
+    """Reference ``:667-690``."""
+    return send_backward(input_tensor_grad, axis_name)
+
+
+def send_forward_backward_recv_forward_backward(
+    output_tensor: Pytree, input_tensor_grad: Pytree,
+    axis_name: Optional[str] = None,
+):
+    """Reference ``:555-582``."""
+    return send_forward(output_tensor, axis_name), send_backward(
+        input_tensor_grad, axis_name
+    )

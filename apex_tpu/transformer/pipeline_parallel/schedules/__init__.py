@@ -1,0 +1,35 @@
+"""Schedule dispatcher (reference
+``apex/transformer/pipeline_parallel/schedules/__init__.py:22-59``)."""
+from ... import parallel_state
+from .common import build_model  # noqa: F401
+from .fwd_bwd_no_pipelining import forward_backward_no_pipelining  # noqa: F401
+from .fwd_bwd_pipelining_with_interleaving import (  # noqa: F401
+    pipeline_forward_backward_interleaved,
+    run_pipeline_interleaved,
+)
+from .fwd_bwd_pipelining_without_interleaving import (  # noqa: F401
+    pipeline_forward_backward,
+    run_pipeline,
+)
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size=None,
+    pipeline_model_parallel_size=None,
+):
+    """Pick the schedule exactly as the reference does (``__init__.py:22-59``):
+    no-pipelining for pp == 1; interleaved when virtual pipelining is
+    configured; 1F1B otherwise."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = (
+            parallel_state.get_pipeline_model_parallel_world_size()
+        )
+    if virtual_pipeline_model_parallel_size is None:
+        virtual_pipeline_model_parallel_size = (
+            parallel_state.get_virtual_pipeline_model_parallel_world_size()
+        )
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return pipeline_forward_backward_interleaved
+        return pipeline_forward_backward
+    return forward_backward_no_pipelining

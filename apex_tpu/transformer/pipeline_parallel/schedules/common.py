@@ -1,0 +1,64 @@
+"""Shared schedule machinery: model building + the stage-step contract.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/common.py`` —
+``build_model`` (``:30``) constructs this rank's model chunk(s) (one per
+virtual-pipeline rank) and wraps them in DDP; ``forward_step`` (``:253``)
+runs one microbatch through one chunk under autocast and collects losses;
+``backward_step`` (``:325``)/``custom_backward`` (``:219``) run the manual
+backward; ``free_output_tensor`` (``:199``) deallocates activations.
+
+TPU-native contract: a *stage function* ``stage_fn(stage_params, hidden) ->
+hidden`` — one microbatch through one pipeline chunk — plus a
+``loss_fn(hidden, microbatch) -> per-microbatch scalar`` applied on the last
+stage. The schedules differentiate the whole pipelined loop with JAX
+autodiff, so there is no hand-written ``backward_step``: the reverse
+schedule (including reverse ppermutes) is the transpose of the forward one.
+``custom_backward``'s job — backward with non-retained grads — is jit
+memory management, which XLA owns. ``free_output_tensor`` maps to buffer
+donation.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ... import parallel_state
+
+Pytree = Any
+
+
+def build_model(
+    model_provider_func: Callable,
+    wrap_with_ddp: bool = True,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    model_type=None,
+    *args,
+    **kwargs,
+) -> List[Any]:
+    """Build this rank's model chunk(s) (reference ``common.py:30-151``).
+
+    With virtual pipelining, one chunk per virtual rank is built, with
+    ``parallel_state``'s virtual rank set during each construction (so
+    providers can query it exactly as in the reference). ``wrap_with_ddp``
+    has no wrapper object in the functional setting — DP grad sync is a
+    transform applied by the caller (``apex_tpu.parallel.sync_gradients``);
+    the flag is accepted for parity.
+    """
+    del model_type, wrap_with_ddp
+    if (
+        parallel_state.get_pipeline_model_parallel_world_size() > 1
+        and virtual_pipeline_model_parallel_size is not None
+    ):
+        model = []
+        for i in range(virtual_pipeline_model_parallel_size):
+            parallel_state.set_virtual_pipeline_model_parallel_rank(i)
+            model.append(model_provider_func(*args, **kwargs))
+        parallel_state.set_virtual_pipeline_model_parallel_rank(0)
+        return model
+    return [model_provider_func(*args, **kwargs)]
+
+
+def _listify(x):
+    return x if isinstance(x, list) else [x]

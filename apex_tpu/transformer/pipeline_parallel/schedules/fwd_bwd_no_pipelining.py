@@ -1,0 +1,90 @@
+"""No-pipelining schedule: sequential microbatches with grad accumulation.
+
+Reference:
+``apex/transformer/pipeline_parallel/schedules/fwd_bwd_no_pipelining.py:23-94``
+— forward+backward per microbatch inside a no-sync context, syncing grads
+only on the final microbatch.
+
+TPU-native: a ``lax.scan`` over microbatches accumulating loss and grads in
+one jitted program; the "sync on last microbatch only" contract is automatic
+because DP grad sync is a transform applied once to the accumulated grads.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def forward_backward_no_pipelining(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params: Pytree,
+    microbatches: Pytree,
+    extras: Optional[Pytree] = None,
+    *,
+    forward_only: bool = False,
+    grad_scaler: Optional[Callable] = None,
+    **parity_kwargs,
+):
+    """Run every microbatch through the full model, accumulating.
+
+    - ``stage_fn(params, x) -> hidden``: the whole model here (single stage).
+    - ``loss_fn(hidden, extra) -> scalar`` per microbatch.
+    - ``microbatches``: pytree with leading microbatch axis.
+    - ``grad_scaler``: optional fn applied to each microbatch loss before
+      differentiation (the reference scales loss before backward,
+      ``common.py:297-305``).
+
+    Returns ``(mean_loss, grads)`` — grads summed over microbatches and
+    divided by the microbatch count (the reference's loss-averaging
+    convention, ``forward_step`` dividing by num_microbatches), or
+    ``(mean_loss, None)`` with ``forward_only=True``.
+
+    Accepted-for-parity kwargs (``tensor_shape``, ``dtype``,
+    ``custom_sync_context_handler``, ...) are ignored: XLA owns those
+    mechanics.
+    """
+    del parity_kwargs
+    n = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+
+    def one_loss(p, mb, ex):
+        out = stage_fn(p, mb)
+        loss = loss_fn(out, ex)
+        if grad_scaler is not None:
+            loss = grad_scaler(loss)
+        return loss
+
+    if extras is None:
+        extras = jax.tree_util.tree_map(
+            lambda _: jnp.zeros((n,)), jnp.zeros((n,))
+        )
+
+    if forward_only:
+        def body(acc, xs):
+            mb, ex = xs
+            return acc + one_loss(params, mb, ex), None
+
+        total, _ = jax.lax.scan(body, 0.0, (microbatches, extras))
+        return total / n, None
+
+    grad_fn = jax.value_and_grad(one_loss)
+
+    def body(carry, xs):
+        acc_loss, acc_grads = carry
+        mb, ex = xs
+        loss, grads = grad_fn(params, mb, ex)
+        new_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
+        return (acc_loss + loss, new_grads), None
+
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+    (total, grads), _ = jax.lax.scan(
+        body, (0.0, zero_grads), (microbatches, extras)
+    )
+    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+    return total / n, grads

@@ -1,0 +1,222 @@
+"""1F1B-equivalent pipelining schedule, single-jit SPMD.
+
+Reference:
+``apex/transformer/pipeline_parallel/schedules/fwd_bwd_pipelining_without_interleaving.py:241-597``
+— warmup (``pp − rank − 1`` microbatches), steady 1F1B
+(send_forward_recv_backward / backward / send_backward_recv_forward),
+cooldown drain; hand-written backward_step per microbatch.
+
+TPU-native: the forward pipeline is a ``lax.scan`` over
+``n_micro + pp − 1`` ticks in which every stage applies its chunk and
+``ppermute``s the activation to its successor; stage 0 injects microbatch
+``t``, the last stage emits microbatch ``t − (pp−1)``. The *backward*
+schedule is not written at all: differentiating the scan transposes every
+ppermute into the reverse hop and replays stages in reverse tick order —
+structurally the same drain the reference's cooldown loop implements. With
+``checkpoint_stages=True`` each stage call is rematerialised in backward,
+bounding live activations to O(in-flight microbatches) — the memory
+property 1F1B buys on CUDA. The warmup/steady/cooldown *phasing* itself is
+XLA's scheduling problem, not Python's.
+
+This function is the *local* (inside-``shard_map``) form so it composes
+with TP/SP/DP axes; ``run_pipeline`` wraps it in a shard_map for the
+single-axis case.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import parallel_state
+
+Pytree = Any
+
+
+def _pipeline_rounds(
+    stage_fn: Callable,
+    stage_params_chunks,  # tuple of per-chunk local params (vpp entries)
+    inputs: jax.Array,  # [n, ...] microbatched first-stage activations
+    axis_name: str,
+    checkpoint_stages: bool,
+) -> jax.Array:
+    """Push all microbatches through ``len(chunks)`` pipeline rounds.
+
+    Round ``r`` runs chunk ``r`` on every stage (virtual pipelining: chunk
+    ``r`` on stage ``s`` holds global layer-block ``r*pp + s``); the last
+    stage's outputs rotate back to stage 0 as the next round's inputs.
+    Returns the last round's outputs ``[n, ...]`` valid on the last stage.
+    """
+    pp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    n = inputs.shape[0]
+    fwd = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+    perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def one_round(params_chunk, round_inputs):
+        def body(state, t):
+            idx = jnp.clip(t, 0, n - 1)
+            inject = jax.lax.dynamic_index_in_dim(
+                round_inputs, idx, 0, keepdims=False
+            )
+            x = jnp.where(rank == 0, inject, state)
+            y = fwd(params_chunk, x)
+            new_state = jax.lax.ppermute(y, axis_name, perm_fwd)
+            # the last stage's y at tick t is microbatch t-(pp-1)
+            return new_state, y
+
+        _, ys = jax.lax.scan(
+            body, jnp.zeros_like(inputs[0]), jnp.arange(n + pp - 1)
+        )
+        return ys[pp - 1 :]  # [n, ...] microbatch-ordered, valid on last stage
+
+    outs = inputs
+    for r, chunk in enumerate(stage_params_chunks):
+        if r > 0:
+            # hand the last stage's outputs back to stage 0 for the next round
+            outs = jax.lax.ppermute(outs, axis_name, perm_fwd)
+        outs = one_round(chunk, outs)
+    return outs
+
+
+def pipeline_forward_backward(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Pytree,
+    inputs: jax.Array,
+    extras: Optional[Pytree] = None,
+    *,
+    forward_only: bool = False,
+    axis_name: Optional[str] = None,
+    checkpoint_stages: bool = True,
+    grad_scaler: Optional[Callable] = None,
+    num_chunks: int = 1,
+    **parity_kwargs,
+):
+    """Local (inside-shard_map) 1F1B-equivalent forward+backward.
+
+    Args:
+      stage_fn: ``(stage_params, hidden) -> hidden`` — one microbatch through
+        this stage's chunk. Uniform across stages (SPMD); per-stage weights
+        live in ``stage_params`` (already the local shard).
+      loss_fn: ``(hidden, extra) -> scalar`` — applied on the last stage.
+      stage_params: local chunk params; with ``num_chunks > 1`` (virtual
+        pipelining, handled by the interleaved wrapper) a leading chunk axis.
+      inputs: ``[n_micro, ...]`` microbatched activations entering stage 0
+        (embedding output; compute embeddings outside, replicated or
+        TP-sharded).
+      extras: per-microbatch loss inputs (labels), leading axis ``n_micro``.
+
+    Returns ``(mean_loss, grads, dinputs)``; the loss is psum-broadcast so
+    every stage reports the same value; grads are wrt the local
+    ``stage_params`` (zero for ticks that never reached the loss);
+    ``dinputs`` is the gradient wrt ``inputs`` (nonzero on stage 0 — for
+    chaining into an embedding backward). With ``forward_only=True`` returns
+    ``(mean_loss, None, None)``.
+    """
+    del parity_kwargs
+    a = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
+    pp = jax.lax.axis_size(a)
+    rank = jax.lax.axis_index(a)
+    n = inputs.shape[0]
+    if extras is None:
+        extras = jnp.zeros((n,))
+
+    def chunks_of(params):
+        if num_chunks == 1:
+            return (params,)
+        return tuple(
+            jax.tree_util.tree_map(lambda p: p[i], params)
+            for i in range(num_chunks)
+        )
+
+    def local_loss(params, inputs):
+        outs = _pipeline_rounds(
+            stage_fn, chunks_of(params), inputs, a, checkpoint_stages
+        )
+
+        def per_micro(carry, xs):
+            y, ex = xs
+            l = loss_fn(y, ex)
+            return carry + l, None
+
+        total, _ = jax.lax.scan(per_micro, 0.0, (outs, extras))
+        # only the last stage's outputs are real; mask others to zero so
+        # their (garbage) loss neither reports nor back-propagates
+        masked = jnp.where(rank == pp - 1, total / n, 0.0)
+        if grad_scaler is not None:
+            masked = grad_scaler(masked)
+        return masked
+
+    if forward_only:
+        loss = local_loss(stage_params, inputs)
+        return jax.lax.psum(loss, a), None, None
+
+    loss, (grads, dinputs) = jax.value_and_grad(local_loss, argnums=(0, 1))(
+        stage_params, inputs
+    )
+    # dinputs is nonzero only on stage 0 (the inject path); psum makes the
+    # embedding gradient identical everywhere for chaining outside shard_map
+    dinputs = jax.lax.psum(dinputs, a)
+    return jax.lax.psum(loss, a), grads, dinputs
+
+
+def run_pipeline(
+    mesh,
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Pytree,
+    inputs: jax.Array,
+    extras: Optional[Pytree] = None,
+    *,
+    forward_only: bool = False,
+    checkpoint_stages: bool = True,
+    num_chunks: int = 1,
+):
+    """Convenience single-axis wrapper: shard_map the local schedule over the
+    ``pipeline`` mesh axis. ``stage_params`` leaves carry a leading ``[pp]``
+    (or ``[pp, num_chunks]`` with virtual chunks) axis sharded across stages.
+
+    Returns ``(loss,)`` if ``forward_only`` else ``(loss, grads, dinputs)``
+    with grads stacked ``[pp, ...]`` like ``stage_params``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ax = parallel_state.PIPELINE_AXIS
+    pspec = jax.tree_util.tree_map(lambda _: P(ax), stage_params)
+    if extras is None:
+        n = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+        extras = jnp.zeros((n,))
+
+    if forward_only:
+        def local_f(params, inputs, extras):
+            params = jax.tree_util.tree_map(lambda p: p[0], params)
+            loss, _, _ = pipeline_forward_backward(
+                stage_fn, loss_fn, params, inputs, extras,
+                forward_only=True, axis_name=ax,
+                checkpoint_stages=checkpoint_stages, num_chunks=num_chunks,
+            )
+            return loss
+
+        return jax.shard_map(
+            local_f, mesh=mesh, in_specs=(pspec, P(), P()),
+            out_specs=P(), check_vma=False,
+        )(stage_params, inputs, extras)
+
+    def local(params, inputs, extras):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        loss, grads, dinp = pipeline_forward_backward(
+            stage_fn, loss_fn, params, inputs, extras,
+            forward_only=False, axis_name=ax,
+            checkpoint_stages=checkpoint_stages, num_chunks=num_chunks,
+        )
+        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+        return loss, grads, dinp
+
+    grads_spec = jax.tree_util.tree_map(lambda _: P(ax), stage_params)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), grads_spec, P()), check_vma=False,
+    )(stage_params, inputs, extras)

@@ -1,0 +1,208 @@
+"""Pipeline-parallel utilities.
+
+Reference: ``apex/transformer/pipeline_parallel/utils.py`` — microbatch
+calculator setup (``:58``), microbatch slicing (``:122``), TP-aware param
+L2 norm (``:213``), DP loss averaging (``:242``), memory reporting
+(``:253``), LM mask/position helpers (``:303``).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+from ..microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+Pytree = Any
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_AUTORESUME = None
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """Reference ``utils.py:58-75``."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("num microbatches calculator is already initialized")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def _reconfigure_microbatch_calculator(
+    rank, rampup_batch_size, global_batch_size, micro_batch_size,
+    data_parallel_size,
+) -> None:
+    """Reference ``utils.py:78-89`` (testing hook)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def destroy_num_microbatches_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_num_microbatches() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def get_micro_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True) -> None:
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(
+        consumed_samples, consistency_check
+    )
+
+
+def get_autoresume():
+    """Reference ``utils.py:142`` — autoresume hook stub."""
+    return _GLOBAL_AUTORESUME
+
+
+def listify_model(model) -> List[Any]:
+    """Reference ``utils.py:115``."""
+    return model if isinstance(model, list) else [model]
+
+
+def get_kth_microbatch(batch: Optional[Pytree], k: int) -> Pytree:
+    """Slice microbatch ``k`` out of a batch whose leaves have the global
+    batch on dim 0 (reference ``utils.py:122-139``)."""
+    if batch is None:
+        return batch
+    mbs = get_micro_batch_size()
+    start, end = k * mbs, (k + 1) * mbs
+    return jax.tree_util.tree_map(lambda t: t[start:end], batch)
+
+
+def split_into_microbatches(batch: Pytree, num_microbatches: int) -> Pytree:
+    """Reshape leaves ``[gbs, ...] -> [n, gbs/n, ...]`` for the scan-based
+    schedules (TPU-native companion to :func:`get_kth_microbatch`)."""
+    return jax.tree_util.tree_map(
+        lambda t: t.reshape((num_microbatches, -1) + t.shape[1:]), batch
+    )
+
+
+def calc_params_l2_norm(params: Pytree, tp_duplicate_paths=(), axis_name=None):
+    """Global L2 norm of params (reference ``utils.py:213-239``).
+
+    The reference drops TP-duplicated params on non-zero TP ranks before the
+    norm; in SPMD, pass the replicated-parameter subtree separately via
+    ``tp_duplicate_paths`` filtering at the call site, or call outside
+    shard_map where params are global. Uses one fused reduction sweep (the
+    ``multi_tensor_l2norm`` analogue).
+    """
+    del tp_duplicate_paths
+    leaves = jax.tree_util.tree_leaves(params)
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    if axis_name is not None:
+        total = jax.lax.psum(total, axis_name)
+    return jnp.sqrt(total)
+
+
+def average_losses_across_data_parallel_group(losses: Sequence, axis_name=None):
+    """Reference ``utils.py:242-250``: mean of the concatenated losses over
+    the DP axis (inside shard_map) or locally (outside)."""
+    a = axis_name if axis_name is not None else parallel_state.DATA_AXIS
+    averaged = jnp.stack([jnp.asarray(l) for l in losses])
+    try:
+        return jax.lax.pmean(averaged, a)
+    except NameError:
+        return averaged
+
+
+def report_memory(name: str) -> str:  # pragma: no cover - device introspection
+    """Reference ``utils.py:253-262``. On TPU, reads live-buffer stats from
+    the backend's memory stats when available."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        mega = 1024 * 1024
+        string = (
+            f"{name} memory (MB) | bytes_in_use: "
+            f"{stats.get('bytes_in_use', 0) / mega:.1f} | peak_bytes_in_use: "
+            f"{stats.get('peak_bytes_in_use', 0) / mega:.1f} | limit: "
+            f"{stats.get('bytes_limit', 0) / mega:.1f}"
+        )
+    except Exception:
+        string = f"{name} memory stats unavailable on this backend"
+    print(string, flush=True)
+    return string
+
+
+def print_params_min_max_norm(params: Pytree, iteration: int) -> None:
+    """Reference ``utils.py:265-300`` param dump."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        l32 = leaf.astype(jnp.float32)
+        print(
+            f"iter {iteration} param {jax.tree_util.keystr(path)} "
+            f"min {float(l32.min()):.4e} max {float(l32.max()):.4e} "
+            f"norm {float(jnp.linalg.norm(l32.ravel())):.4e}",
+            flush=True,
+        )
+
+
+def get_ltor_masks_and_position_ids(
+    data: jax.Array,
+    eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right LM masks/positions (reference ``utils.py:303-357``).
+
+    Returns ``(attention_mask [b,1,s,s] bool where True = masked out,
+    loss_mask [b,s], position_ids [b,s])``. The per-document reset options
+    are implemented with cumulative-EOD arithmetic instead of the
+    reference's per-example Python loop (XLA-friendly, no host sync).
+    """
+    b, s = data.shape
+    # causal base mask: True above the diagonal = masked
+    causal = jnp.triu(jnp.ones((s, s), bool), k=1)
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    is_eod = (data == eod_token)
+    # document id of each token: number of EODs strictly before it
+    doc_id = jnp.cumsum(is_eod, axis=1) - jnp.where(is_eod, 1, 0)
+
+    if reset_position_ids:
+        # position within document: global pos − pos of document start.
+        # an EOD at p starts a new document at p+1 (the EOD itself keeps its
+        # position in the preceding document, reference utils.py:342-353)
+        doc_start = jnp.where(is_eod, position_ids + 1, 0)
+        doc_start = jnp.pad(doc_start[:, :-1], ((0, 0), (1, 0)))
+        start_of_doc = jax.lax.associative_scan(jnp.maximum, doc_start, axis=1)
+        position_ids = position_ids - start_of_doc
+
+    attention_mask = jnp.broadcast_to(causal, (b, 1, s, s))
+    if reset_attention_mask:
+        # tokens may not attend across document boundaries
+        same_doc = doc_id[:, None, :, None] == doc_id[:, None, None, :]
+        attention_mask = attention_mask | ~same_doc
+
+    return attention_mask, loss_mask, position_ids
