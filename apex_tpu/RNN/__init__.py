@@ -1,0 +1,3 @@
+"""Deprecated RNN backend (reference ``apex/RNN/__init__.py``)."""
+from .models import GRU, LSTM, ReLU, RNN, Tanh, mLSTM  # noqa: F401
+from .cells import GRUCell, LSTMCell, RNNReLUCell, RNNTanhCell, mLSTMCell  # noqa: F401

@@ -1,0 +1,77 @@
+"""RNN cell functions.
+
+Reference: ``apex/RNN/cells.py`` — pure-Python cell math (the package is
+deprecated upstream; it exists because amp's RNN casting needed a
+monkey-patchable backend). Here: plain functions ``cell(params, x, state) ->
+state`` suitable for ``lax.scan``.
+
+Parameter layout per cell: ``w_ih [gates*h, in]``, ``w_hh [gates*h, h]``,
+``b_ih``/``b_hh`` optional.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _linear(x, w, b):
+    y = jnp.einsum("...i,oi->...o", x, w)
+    return y + b if b is not None else y
+
+
+def RNNReLUCell(params, x, h):
+    """h' = relu(W_ih x + W_hh h) (reference ``cells.py`` RNNReLUCell)."""
+    return jax.nn.relu(
+        _linear(x, params["w_ih"], params.get("b_ih"))
+        + _linear(h, params["w_hh"], params.get("b_hh"))
+    )
+
+
+def RNNTanhCell(params, x, h):
+    return jnp.tanh(
+        _linear(x, params["w_ih"], params.get("b_ih"))
+        + _linear(h, params["w_hh"], params.get("b_hh"))
+    )
+
+
+def LSTMCell(params, x, state: Tuple[jax.Array, jax.Array]):
+    """(h, c) -> (h', c'), gate order i,f,g,o (torch convention,
+    reference ``cells.py`` LSTMCell)."""
+    h, c = state
+    gates = _linear(x, params["w_ih"], params.get("b_ih")) + _linear(
+        h, params["w_hh"], params.get("b_hh")
+    )
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    return jnp.tanh(c2) * o, c2
+
+
+def GRUCell(params, x, h):
+    """Gate order r,z,n (torch convention, reference ``cells.py`` GRUCell)."""
+    gi = _linear(x, params["w_ih"], params.get("b_ih"))
+    gh = _linear(h, params["w_hh"], params.get("b_hh"))
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1 - z) * n + z * h
+
+
+def mLSTMCell(params, x, state: Tuple[jax.Array, jax.Array]):
+    """Multiplicative LSTM (reference ``cells.py`` mLSTMRNNCell): the hidden
+    state is modulated by ``m = (W_mih x) * (W_mhh h)`` before the gates."""
+    h, c = state
+    m = _linear(x, params["w_mih"], None) * _linear(h, params["w_mhh"], None)
+    gates = _linear(x, params["w_ih"], params.get("b_ih")) + _linear(
+        m, params["w_hh"], params.get("b_hh")
+    )
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    return jnp.tanh(c2) * o, c2

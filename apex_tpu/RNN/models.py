@@ -1,0 +1,177 @@
+"""Stacked/bidirectional RNN models over the cells.
+
+Reference: ``apex/RNN/models.py`` + ``RNNBackend.py`` — factory functions
+(``LSTM``/``GRU``/``ReLU``/``Tanh``/``mLSTM``) returning a stacked RNN
+backend with optional bidirection and inter-layer dropout.
+
+TPU-native: each layer is a ``lax.scan`` over time (sequence-major
+``[seq, batch, feature]``, torch's default ``batch_first=False``);
+stacking/bidirection are Python composition. Dropout takes an explicit PRNG
+key (functional), applied between layers as in the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import cells as _cells
+
+Pytree = Any
+
+
+class _RNNModel:
+    """Stacked (optionally bidirectional) scan-RNN.
+
+    The reference ``RNNBackend.stackedRNN`` equivalent. ``init(key)`` builds
+    the param pytree; ``__call__(params, x, initial_state=None, dropout_key=
+    None)`` returns ``(outputs [s,b,h*(2 if bidir)], final_states)``.
+    """
+
+    def __init__(
+        self,
+        cell: Callable,
+        gates: int,
+        input_size: int,
+        hidden_size: int,
+        num_layers: int,
+        bias: bool = True,
+        batch_first: bool = False,
+        dropout: float = 0.0,
+        bidirectional: bool = False,
+        output_size: Optional[int] = None,
+        is_lstm: bool = False,
+        multiplicative: bool = False,
+    ):
+        self.cell = cell
+        self.gates = gates
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.batch_first = batch_first
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+        self.output_size = output_size
+        self.is_lstm = is_lstm
+        self.multiplicative = multiplicative
+
+    def _cell_params(self, key, in_size) -> Dict[str, jax.Array]:
+        k = 1.0 / math.sqrt(self.hidden_size)
+        keys = jax.random.split(key, 6)
+        g = self.gates * self.hidden_size
+
+        def u(kk, shape):
+            return jax.random.uniform(kk, shape, minval=-k, maxval=k)
+
+        p = {
+            "w_ih": u(keys[0], (g, in_size)),
+            "w_hh": u(keys[1], (g, self.hidden_size)),
+        }
+        if self.bias:
+            p["b_ih"] = u(keys[2], (g,))
+            p["b_hh"] = u(keys[3], (g,))
+        if self.multiplicative:
+            p["w_mih"] = u(keys[4], (self.hidden_size, in_size))
+            p["w_mhh"] = u(keys[5], (self.hidden_size, self.hidden_size))
+        return p
+
+    def init(self, key: jax.Array) -> Pytree:
+        dirs = 2 if self.bidirectional else 1
+        params = []
+        for layer in range(self.num_layers):
+            in_size = self.input_size if layer == 0 else self.hidden_size * dirs
+            layer_params = []
+            for d in range(dirs):
+                key, sub = jax.random.split(key)
+                layer_params.append(self._cell_params(sub, in_size))
+            params.append(layer_params)
+        out = {"layers": params}
+        if self.output_size is not None:
+            key, sub = jax.random.split(key)
+            out["proj"] = jax.random.normal(
+                sub, (self.output_size, self.hidden_size * dirs)
+            ) / math.sqrt(self.hidden_size * dirs)
+        return out
+
+    def _zero_state(self, batch):
+        h = jnp.zeros((batch, self.hidden_size))
+        return (h, jnp.zeros_like(h)) if self.is_lstm else h
+
+    def _run_dir(self, cell_params, x, reverse: bool):
+        if reverse:
+            x = jnp.flip(x, axis=0)
+
+        def step(state, xt):
+            new_state = self.cell(cell_params, xt, state)
+            out = new_state[0] if self.is_lstm else new_state
+            return new_state, out
+
+        final, outs = jax.lax.scan(step, self._zero_state(x.shape[1]), x)
+        if reverse:
+            outs = jnp.flip(outs, axis=0)
+        return outs, final
+
+    def __call__(
+        self,
+        params: Pytree,
+        x: jax.Array,
+        dropout_key: Optional[jax.Array] = None,
+    ):
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        finals = []
+        h = x
+        for layer, layer_params in enumerate(params["layers"]):
+            outs_f, fin_f = self._run_dir(layer_params[0], h, False)
+            if self.bidirectional:
+                outs_b, fin_b = self._run_dir(layer_params[1], h, True)
+                h = jnp.concatenate([outs_f, outs_b], axis=-1)
+                finals.append((fin_f, fin_b))
+            else:
+                h = outs_f
+                finals.append(fin_f)
+            if (
+                self.dropout > 0
+                and dropout_key is not None
+                and layer < self.num_layers - 1
+            ):
+                dropout_key, sub = jax.random.split(dropout_key)
+                keep = jax.random.bernoulli(sub, 1 - self.dropout, h.shape)
+                h = jnp.where(keep, h / (1 - self.dropout), 0)
+        if "proj" in params:
+            h = jnp.einsum("sbi,oi->sbo", h, params["proj"])
+        if self.batch_first:
+            h = jnp.swapaxes(h, 0, 1)
+        return h, finals
+
+
+def _factory(cell, gates, is_lstm=False, multiplicative=False):
+    def make(
+        input_size,
+        hidden_size,
+        num_layers,
+        bias=True,
+        batch_first=False,
+        dropout=0.0,
+        bidirectional=False,
+        output_size=None,
+    ):
+        return _RNNModel(
+            cell, gates, input_size, hidden_size, num_layers, bias,
+            batch_first, dropout, bidirectional, output_size,
+            is_lstm=is_lstm, multiplicative=multiplicative,
+        )
+
+    return make
+
+
+# reference apex/RNN/models.py:21-56 factory surface
+LSTM = _factory(_cells.LSTMCell, 4, is_lstm=True)
+GRU = _factory(_cells.GRUCell, 3)
+ReLU = _factory(_cells.RNNReLUCell, 1)
+Tanh = _factory(_cells.RNNTanhCell, 1)
+mLSTM = _factory(_cells.mLSTMCell, 4, is_lstm=True, multiplicative=True)
+RNN = Tanh  # reference RNN default is tanh

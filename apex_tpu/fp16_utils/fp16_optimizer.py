@@ -1,0 +1,152 @@
+"""FP16_Optimizer: master-weight mixed-precision optimizer wrapper.
+
+Reference: ``apex/fp16_utils/fp16_optimizer.py:13-556`` — wraps any
+optimizer with fp32 master copies of fp16 params, loss scaling
+(static/dynamic), overflow skip-step, ``clip_master_grads``, and a
+state_dict including masters.
+
+TPU-native: a functional wrapper following the ``apex_tpu.optimizers``
+protocol; state carries masters + inner optimizer state + the jit-friendly
+scaler state from ``apex_tpu.amp``. The whole step (unscale → overflow
+check → cond(skip, update) → cast-back) traces into one program.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..amp.scaler import LossScaleState, LossScaler
+from ..ops.multi_tensor import multi_tensor_l2norm
+from .fp16util import master_params_to_model_params, prep_param_lists
+
+Pytree = Any
+
+
+class FP16OptimizerState(NamedTuple):
+    masters: Pytree  # fp32 master params
+    inner: Any  # wrapped optimizer state (over masters)
+    scaler: LossScaleState
+
+
+class FP16_Optimizer:
+    """Reference ``FP16_Optimizer`` (``fp16_optimizer.py:13``).
+
+    Usage (functional spelling of init_optimizer/backward/step):
+
+        opt = FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True)
+        state = opt.init(half_params)
+        loss = ...  # computed from half_params
+        scaled_grads = jax.grad(lambda p: opt.scale_loss(state, loss_fn(p)))(...)
+        half_params, state = opt.step(scaled_grads, state, half_params)
+    """
+
+    def __init__(
+        self,
+        init_optimizer,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+        verbose: bool = False,
+    ):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            args = dynamic_loss_args or {}
+            self.loss_scaler = LossScaler("dynamic", **args)
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.verbose = verbose
+
+    def init(self, params: Pytree) -> FP16OptimizerState:
+        _, masters = prep_param_lists(params)
+        return FP16OptimizerState(
+            masters=masters,
+            inner=self.optimizer.init(masters),
+            scaler=self.loss_scaler.init_state(),
+        )
+
+    # -- loss scaling ------------------------------------------------------
+    def scale_loss(self, state: FP16OptimizerState, loss: jax.Array) -> jax.Array:
+        """The ``optimizer.backward(loss)`` scaling half
+        (``fp16_optimizer.py:322-356``)."""
+        return self.loss_scaler.scale_loss(state.scaler, loss)
+
+    @property
+    def loss_scale(self):
+        """Reference property (``fp16_optimizer.py:547-556``) — note: on the
+        functional API read ``state.scaler.loss_scale`` instead."""
+        return self.loss_scaler
+
+    # -- step --------------------------------------------------------------
+    def step(
+        self,
+        scaled_grads: Pytree,
+        state: FP16OptimizerState,
+        params: Pytree,
+        max_grad_norm: Optional[float] = None,
+    ) -> Tuple[Pytree, FP16OptimizerState]:
+        """Unscale → (clip) → overflow-gated master update → cast-back.
+
+        Mirrors ``FP16_Optimizer.step`` (``fp16_optimizer.py:363-418``) with
+        ``clip_master_grads`` (``:420-455``) folded in via ``max_grad_norm``.
+        """
+        master_grads, scaler_state = self.loss_scaler.unscale(
+            state.scaler, scaled_grads, out_dtype=jnp.float32
+        )
+
+        if max_grad_norm is not None:
+            master_grads = self.clip_master_grads(master_grads, max_grad_norm)
+
+        def do_step(_):
+            new_masters, new_inner = self.optimizer.step(
+                master_grads, state.inner, state.masters
+            )
+            return new_masters, new_inner
+
+        def skip_step(_):
+            return state.masters, state.inner
+
+        new_masters, new_inner = jax.lax.cond(
+            scaler_state.found_inf, skip_step, do_step, operand=None
+        )
+        new_scaler = self.loss_scaler.update_scale(scaler_state)
+        new_params = master_params_to_model_params(params, new_masters)
+        return new_params, FP16OptimizerState(
+            masters=new_masters, inner=new_inner, scaler=new_scaler
+        )
+
+    def clip_master_grads(self, master_grads: Pytree, max_norm: float) -> Pytree:
+        """Standalone grad clip over masters (``fp16_optimizer.py:420-455``)."""
+        norm, _ = multi_tensor_l2norm(master_grads)
+        clip = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * clip, master_grads)
+
+    # -- checkpointing (``fp16_optimizer.py:212-273``) ---------------------
+    def state_dict(self, state: FP16OptimizerState) -> dict:
+        return {
+            "loss_scaler": self.loss_scaler.state_dict(state.scaler),
+            "fp32_from_fp16": jax.device_get(state.masters),
+            "optimizer_state": jax.device_get(state.inner),
+        }
+
+    def load_state_dict(self, sd: dict, state: FP16OptimizerState) -> FP16OptimizerState:
+        masters = jax.tree_util.tree_map(
+            lambda old, new: jnp.asarray(new, old.dtype)
+            if hasattr(old, "dtype")
+            else new,
+            state.masters,
+            sd["fp32_from_fp16"],
+        )
+        inner = jax.tree_util.tree_map(
+            lambda old, new: jnp.asarray(new, old.dtype)
+            if hasattr(old, "dtype")
+            else new,
+            state.inner,
+            sd["optimizer_state"],
+        )
+        return FP16OptimizerState(
+            masters=masters,
+            inner=inner,
+            scaler=self.loss_scaler.load_state_dict(sd["loss_scaler"]),
+        )

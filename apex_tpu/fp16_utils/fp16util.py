@@ -1,0 +1,114 @@
+"""Pytree casting helpers for manual mixed precision.
+
+Reference: ``apex/fp16_utils/fp16util.py`` — module-walking converters
+(``network_to_half``, ``convert_network`` keeping BatchNorm fp32,
+``FP16Model``) and the master-param bookkeeping
+(``prep_param_lists``, ``master_params_to_model_params``,
+``model_grads_to_master_grads``).
+
+TPU-native: models are parameter pytrees, so "walking the module tree"
+becomes mapping over leaves with a path predicate. bf16 is the default half
+dtype on TPU (fp16 supported for parity).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+#: path substrings kept in fp32 by convert_network — the pytree analogue of
+#: the reference's "leave torch.nn.modules.batchnorm._BatchNorm in fp32"
+#: (fp16util.py:30-42)
+DEFAULT_FP32_PATH_PATTERNS = ("batch_stats", "batchnorm", "bn", "norm")
+
+
+def _is_float(leaf) -> bool:
+    return hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)
+
+
+def tofp16(tree: Pytree, dtype=jnp.bfloat16) -> Pytree:
+    """Cast every float leaf (reference ``tofp16`` ``fp16util.py:18-21``)."""
+    return jax.tree_util.tree_map(
+        lambda l: l.astype(dtype) if _is_float(l) else l, tree
+    )
+
+
+def network_to_half(tree: Pytree, dtype=jnp.bfloat16) -> Pytree:
+    """Reference ``network_to_half`` (``fp16util.py:44-50``) — everything to
+    half, including norm layers (use :func:`convert_network` to keep them
+    fp32)."""
+    return tofp16(tree, dtype)
+
+
+def convert_network(
+    tree: Pytree,
+    dtype=jnp.bfloat16,
+    keep_fp32: Optional[Callable[[str], bool]] = None,
+) -> Pytree:
+    """Cast float leaves to ``dtype``, keeping norm-like params fp32.
+
+    Reference ``convert_network`` (``fp16util.py:53-62``): BatchNorm modules
+    stay fp32. ``keep_fp32`` receives the flattened key path string; the
+    default matches :data:`DEFAULT_FP32_PATH_PATTERNS`.
+    """
+    if keep_fp32 is None:
+        def keep_fp32(path: str) -> bool:
+            p = path.lower()
+            return any(pat in p for pat in DEFAULT_FP32_PATH_PATTERNS)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path)
+        if _is_float(leaf) and not keep_fp32(pstr):
+            out.append(leaf.astype(dtype))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class FP16Model:
+    """Reference ``FP16Model`` (``fp16util.py:65-77``): wraps an apply
+    function so inputs are cast to half and the network runs in half."""
+
+    def __init__(self, apply_fn: Callable, dtype=jnp.bfloat16):
+        self.apply_fn = apply_fn
+        self.dtype = dtype
+
+    def __call__(self, params: Pytree, *inputs, **kwargs):
+        half_inputs = tofp16(inputs, self.dtype)
+        return self.apply_fn(network_to_half(params, self.dtype), *half_inputs,
+                             **kwargs)
+
+
+def prep_param_lists(params: Pytree) -> Tuple[Pytree, Pytree]:
+    """(model_params, fp32 master copies) — reference ``prep_param_lists``
+    (``fp16util.py:80-120``; the flat-master option collapses into the
+    pytree)."""
+    masters = jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32) if _is_float(l) else l, params
+    )
+    return params, masters
+
+
+def master_params_to_model_params(model_params: Pytree, master_params: Pytree) -> Pytree:
+    """Copy masters into the model dtype (reference ``fp16util.py:123-140``)."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype) if _is_float(p) else m,
+        master_params, model_params,
+    )
+
+
+def model_grads_to_master_grads(model_grads: Pytree) -> Pytree:
+    """Upcast grads to fp32 (reference ``fp16util.py:143-160``)."""
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) if _is_float(g) else g, model_grads
+    )
+
+
+def to_python_float(t) -> float:
+    """Reference ``to_python_float`` (``fp16util.py:163-167``)."""
+    return float(jax.device_get(t))

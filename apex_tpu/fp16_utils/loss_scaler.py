@@ -1,0 +1,97 @@
+"""Legacy loss scalers (reference ``apex/fp16_utils/loss_scaler.py``).
+
+``LossScaler`` (static) and ``DynamicLossScaler`` with the classic
+``has_overflow`` / ``update_scale`` / ``backward`` API. The modern engine is
+``apex_tpu.amp.LossScaler`` (jit-carried state); these classes keep the
+legacy host-driven interface for parity — state lives on the Python object,
+so use them only outside jit (exactly how the originals were used).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def _has_inf_or_nan(tree: Pytree) -> bool:
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    if not leaves:
+        return False
+    return bool(
+        jax.device_get(
+            jnp.any(
+                jnp.stack(
+                    [~jnp.all(jnp.isfinite(l.astype(jnp.float32))) for l in leaves]
+                )
+            )
+        )
+    )
+
+
+class LossScaler:
+    """Static scaler (reference ``loss_scaler.py:8-58``)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = scale
+
+    def has_overflow(self, params: Pytree) -> bool:
+        return False
+
+    @staticmethod
+    def _has_inf_or_nan(x) -> bool:
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads: Pytree) -> Pytree:
+        return jax.tree_util.tree_map(lambda g: g * self.cur_scale, grads)
+
+    def unscale_gradient(self, grads: Pytree) -> Pytree:
+        inv = 1.0 / self.cur_scale
+        return jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    def backward(self, loss_and_grad_fn, *args):
+        """Compute grads of ``scale * loss`` (the legacy
+        ``scaled_loss.backward()`` idiom)."""
+        loss, grads = loss_and_grad_fn(*args)
+        return loss, self.scale_gradient(grads)
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scaler (reference ``loss_scaler.py:60-164``): ×2 every
+    ``scale_window`` clean iterations, ÷2 on overflow."""
+
+    def __init__(
+        self,
+        init_scale: float = 2 ** 32,
+        scale_factor: float = 2.0,
+        scale_window: int = 1000,
+    ):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, grads: Pytree) -> bool:
+        return _has_inf_or_nan(grads)
+
+    @staticmethod
+    def _has_inf_or_nan(x) -> bool:
+        return _has_inf_or_nan(x)
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

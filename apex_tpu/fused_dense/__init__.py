@@ -1,0 +1,8 @@
+"""Fused dense layers (reference ``apex/fused_dense/__init__.py``)."""
+from .fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+    fused_dense,
+    dense_no_bias,
+    fused_dense_gelu_dense,
+)

@@ -1,0 +1,122 @@
+"""Fused GEMM+bias and GEMM+bias+GeLU+GEMM.
+
+Reference: ``apex/fused_dense/fused_dense.py`` + ``csrc/fused_dense_cuda.cu``
+— cublasLt-epilogue-fused linear layers: ``linear_bias_forward`` and
+``linear_gelu_linear_forward`` with hand-written backwards returning
+input/weight/bias grads (and saving ``gelu_in`` for the middle activation).
+
+TPU-native: XLA fuses bias and GeLU into the MXU matmul epilogues when the
+chain is traced together; autodiff reproduces the saved-``gelu_in``
+backward (the residual is the pre-activation, exactly what the reference
+stashes). fp32 accumulation via ``preferred_element_type``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+
+    _HAVE_FLAX = True
+except Exception:  # pragma: no cover
+    _HAVE_FLAX = False
+
+
+def _matmul_t(x, w):
+    # torch Linear layout: w [out, in]
+    return jnp.einsum(
+        "...i,oi->...o", x, w, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+
+def fused_dense(x: jax.Array, weight: jax.Array, bias: jax.Array) -> jax.Array:
+    """GEMM + bias (reference ``FusedDenseFunc`` ``fused_dense.py:7-18``)."""
+    y = _matmul_t(x, weight)
+    return y + bias.astype(y.dtype)
+
+
+def dense_no_bias(x: jax.Array, weight: jax.Array) -> jax.Array:
+    """GEMM (reference ``DenseNoBiasFunc`` ``fused_dense.py:20-33``)."""
+    return _matmul_t(x, weight)
+
+
+def fused_dense_gelu_dense(
+    x: jax.Array,
+    weight1: jax.Array,
+    bias1: jax.Array,
+    weight2: jax.Array,
+    bias2: jax.Array,
+) -> jax.Array:
+    """GEMM + bias + GeLU + GEMM + bias (reference
+    ``FusedDenseGeluDenseFunc`` ``fused_dense.py:35-47``). Uses tanh-GeLU,
+    the variant the CUDA kernel implements."""
+    h = _matmul_t(x, weight1)
+    h = jax.nn.gelu(h + bias1.astype(h.dtype), approximate=True)
+    y = _matmul_t(h, weight2)
+    return y + bias2.astype(y.dtype)
+
+
+if _HAVE_FLAX:
+
+    def _linear_init(bound):
+        def init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(
+                key, shape, dtype, minval=-bound, maxval=bound
+            )
+
+        return init
+
+    class FusedDense(nn.Module):
+        """Reference ``FusedDense`` (``fused_dense.py:64-80``)."""
+
+        in_features: int
+        out_features: int
+        bias: bool = True
+
+        @nn.compact
+        def __call__(self, x):
+            bound = 1.0 / (self.in_features ** 0.5)
+            w = self.param(
+                "weight", _linear_init(bound),
+                (self.out_features, self.in_features),
+            )
+            if self.bias:
+                b = self.param("bias", _linear_init(bound), (self.out_features,))
+                return fused_dense(x, w, b)
+            return dense_no_bias(x, w)
+
+    class FusedDenseGeluDense(nn.Module):
+        """Reference ``FusedDenseGeluDense`` (``fused_dense.py:82-98``)."""
+
+        in_features: int
+        intermediate_features: int
+        out_features: int
+        bias: bool = True
+
+        @nn.compact
+        def __call__(self, x):
+            if not self.bias:
+                raise RuntimeError(
+                    "FusedDenseGeluDense module requires bias=True (reference "
+                    "fused_dense.py:85)"
+                )
+            b1 = 1.0 / (self.in_features ** 0.5)
+            b2 = 1.0 / (self.intermediate_features ** 0.5)
+            w1 = self.param(
+                "weight1", _linear_init(b1),
+                (self.intermediate_features, self.in_features),
+            )
+            bias1 = self.param(
+                "bias1", _linear_init(b1), (self.intermediate_features,)
+            )
+            w2 = self.param(
+                "weight2", _linear_init(b2),
+                (self.out_features, self.intermediate_features),
+            )
+            bias2 = self.param(
+                "bias2", _linear_init(b2), (self.out_features,)
+            )
+            return fused_dense_gelu_dense(x, w1, bias1, w2, bias2)

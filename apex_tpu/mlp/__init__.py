@@ -1,0 +1,2 @@
+"""Fused MLP (reference ``apex/mlp/__init__.py``)."""
+from .mlp import MLP, mlp  # noqa: F401

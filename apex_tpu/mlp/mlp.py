@@ -1,0 +1,101 @@
+"""Fused multi-layer perceptron.
+
+Reference: ``apex/mlp/mlp.py`` + ``csrc/mlp_cuda.cu`` — a chain of
+GEMM + bias + activation (none/relu/sigmoid) executed as one C++ call with
+cuBLAS GEMMs and fused epilogues, plus a hand-written backward.
+
+TPU-native: the whole chain traced in one function IS the fused form — XLA
+maps the GEMMs onto the MXU and fuses bias+activation into their epilogues;
+autodiff reproduces the hand-written backward. ``preferred_element_type``
+keeps bf16 inputs accumulating in fp32 like the cuBLAS kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+
+    _HAVE_FLAX = True
+except Exception:  # pragma: no cover
+    _HAVE_FLAX = False
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp(
+    x: jax.Array,
+    weights: Sequence[jax.Array],
+    biases: Optional[Sequence[jax.Array]] = None,
+    activation: str = "relu",
+) -> jax.Array:
+    """Functional fused MLP (reference ``MlpFunction`` ``mlp.py:11-25``).
+
+    ``weights[i]`` is ``[out_i, in_i]`` (torch layout); activation is applied
+    after every layer except the last — matching ``mlp_cuda``'s semantics.
+    """
+    if activation not in _ACTIVATIONS:
+        raise TypeError("activation must be relu or none or sigmoid")
+    act = _ACTIVATIONS[activation]
+    h = x
+    for i, w in enumerate(weights):
+        h = jnp.einsum(
+            "...i,oi->...o", h, w, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        if biases is not None and biases[i] is not None:
+            h = h + biases[i].astype(h.dtype)
+        # mlp_cuda applies the activation after EVERY layer, including the
+        # last (csrc/mlp_cuda.cu forward loop; tests/L0/run_mlp/test_mlp.py
+        # appends ReLU after each Linear)
+        h = act(h)
+    return h
+
+
+if _HAVE_FLAX:
+
+    class MLP(nn.Module):
+        """Module form (reference ``MLP`` ``apex/mlp/mlp.py:33-86``).
+
+        ``mlp_sizes=[1024, 1024, 1024]`` creates two 1024x1024 layers.
+        Weight init mirrors the reference's uniform ``1/sqrt(fan_in)``
+        (``mlp.py:66-72``).
+        """
+
+        mlp_sizes: Sequence[int]
+        bias: bool = True
+        activation: str = "relu"
+
+        @nn.compact
+        def __call__(self, x):
+            weights, biases = [], []
+            for i in range(len(self.mlp_sizes) - 1):
+                fan_in = self.mlp_sizes[i]
+                bound = 1.0 / (fan_in ** 0.5)
+                weights.append(
+                    self.param(
+                        f"weight_{i}",
+                        lambda k, s, b=bound: jax.random.uniform(
+                            k, s, minval=-b, maxval=b
+                        ),
+                        (self.mlp_sizes[i + 1], fan_in),
+                    )
+                )
+                biases.append(
+                    self.param(
+                        f"bias_{i}",
+                        lambda k, s, b=bound: jax.random.uniform(
+                            k, s, minval=-b, maxval=b
+                        ),
+                        (self.mlp_sizes[i + 1],),
+                    )
+                    if self.bias
+                    else None
+                )
+            return mlp(x, weights, biases if self.bias else None, self.activation)

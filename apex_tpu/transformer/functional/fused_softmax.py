@@ -339,4 +339,4 @@ class FusedScaleMaskSoftmax:
     def get_batch_per_block(sq, sk, b, np_):
         """CUDA occupancy helper (reference ``fused_softmax.py:272-274``).
         On TPU the analogous quantity is rows per Pallas block."""
-        return _row_block(b * np_ * sq)
+        return _row_block(b * np_ * sq, sk)

@@ -7,9 +7,10 @@ reads to all-reduce those grads across the TP group (under SP, layernorm
 params are replicated while activations are sequence-sharded).
 
 TPU-native: flax params carry no attributes, so the tag lives on the module
-and is exported via ``sequence_parallel_param_names`` — the grad-sync
-transform (``pipeline_parallel.utils.allreduce_sequence_parallel_grads``)
-matches parameter paths against these names. ``FastLayerNorm`` (the contrib
+and is exported via ``sequence_parallel_param_names`` (matching the flax
+param names ``weight``/``bias``) — the grad-sync transform
+``pipeline_parallel.utils.allreduce_sequence_parallel_grads`` matches
+parameter paths against these names. ``FastLayerNorm`` (the contrib
 persistent kernel) maps to the same Pallas kernel; it exists as a separate
 name for API parity.
 """
@@ -30,7 +31,7 @@ class FusedLayerNorm(_BaseFusedLayerNorm):
 
     @property
     def sequence_parallel_param_names(self):
-        return ("scale", "bias") if self.sequence_parallel_enabled else ()
+        return ("weight", "bias") if self.sequence_parallel_enabled else ()
 
 
 class MixedFusedLayerNorm(_BaseMixedFusedLayerNorm):
@@ -40,7 +41,7 @@ class MixedFusedLayerNorm(_BaseMixedFusedLayerNorm):
 
     @property
     def sequence_parallel_param_names(self):
-        return ("scale", "bias") if self.sequence_parallel_enabled else ()
+        return ("weight", "bias") if self.sequence_parallel_enabled else ()
 
 
 class FastLayerNorm(FusedLayerNorm):

@@ -59,9 +59,7 @@ def forward_backward_no_pipelining(
         return loss
 
     if extras is None:
-        extras = jax.tree_util.tree_map(
-            lambda _: jnp.zeros((n,)), jnp.zeros((n,))
-        )
+        extras = jnp.zeros((n,))
 
     if forward_only:
         def body(acc, xs):

@@ -12,6 +12,7 @@ from typing import Any, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from ...ops.multi_tensor import multi_tensor_l2norm
 from .. import parallel_state
 from ..microbatches import (
     ConstantNumMicroBatches,
@@ -115,11 +116,39 @@ def calc_params_l2_norm(params: Pytree, tp_duplicate_paths=(), axis_name=None):
     ``multi_tensor_l2norm`` analogue).
     """
     del tp_duplicate_paths
-    leaves = jax.tree_util.tree_leaves(params)
-    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    norm, _ = multi_tensor_l2norm(params)
     if axis_name is not None:
-        total = jax.lax.psum(total, axis_name)
-    return jnp.sqrt(total)
+        norm = jnp.sqrt(jax.lax.psum(jnp.square(norm), axis_name))
+    return norm
+
+
+def allreduce_sequence_parallel_grads(
+    grads: Pytree,
+    param_names: Sequence[str] = ("weight", "bias"),
+    axis_name: Optional[str] = None,
+) -> Pytree:
+    """All-reduce grads of sequence-parallel-replicated params over TP.
+
+    Under Megatron sequence parallelism, layernorm weights are replicated
+    across TP ranks while their activations are sequence-sharded, so their
+    grads must be summed across the TP group — the grad-sync loop the
+    reference runs over params tagged ``sequence_parallel_enabled``
+    (``apex/transformer/layers/layer_norm.py:26-50`` tagging; consumed by
+    Megatron-style trainers). Grads whose path contains any of
+    ``param_names`` (the names exported by
+    ``transformer.layers.FusedLayerNorm.sequence_parallel_param_names``)
+    are psum'd over ``axis_name``; call inside shard_map.
+    """
+    a = axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
+    flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    out = []
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path)
+        if any(name in pstr for name in param_names):
+            out.append(jax.lax.psum(leaf, a))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def average_losses_across_data_parallel_group(losses: Sequence, axis_name=None):

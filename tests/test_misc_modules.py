@@ -1,0 +1,221 @@
+"""Tests for mlp, fused_dense, fp16_utils, RNN.
+
+Mirrors reference L0 suites: ``test_mlp.py`` (MLP vs nn.Sequential),
+fused_dense test, ``run_fp16util``, ``test_rnn.py``.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fused_dense import (
+    FusedDense,
+    FusedDenseGeluDense,
+    dense_no_bias,
+    fused_dense,
+    fused_dense_gelu_dense,
+)
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    DynamicLossScaler,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+from apex_tpu.mlp import MLP, mlp
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+def test_mlp_matches_sequential():
+    sizes = [7, 9, 5]
+    ws = [
+        jax.random.normal(jax.random.PRNGKey(i), (sizes[i + 1], sizes[i])) * 0.3
+        for i in range(2)
+    ]
+    bs = [jnp.ones((sizes[i + 1],)) * 0.1 for i in range(2)]
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 7))
+
+    # mlp_cuda applies the activation after every layer including the last
+    y = mlp(x, ws, bs, activation="relu")
+    ref = jax.nn.relu(jax.nn.relu(x @ ws[0].T + bs[0]) @ ws[1].T + bs[1])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+    y_sig = mlp(x, ws, bs, activation="sigmoid")
+    ref_sig = jax.nn.sigmoid(
+        jax.nn.sigmoid(x @ ws[0].T + bs[0]) @ ws[1].T + bs[1]
+    )
+    np.testing.assert_allclose(np.asarray(y_sig), np.asarray(ref_sig), atol=1e-5)
+
+    with pytest.raises(TypeError):
+        mlp(x, ws, bs, activation="tanh")
+
+
+def test_mlp_module_and_grads():
+    m = MLP([6, 8, 4], bias=True, activation="relu")
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 6))
+    variables = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(variables, x)
+    assert y.shape == (3, 4)
+    g = jax.grad(lambda v: jnp.sum(m.apply(v, x) ** 2))(variables)
+    assert jnp.isfinite(
+        jnp.concatenate([l.ravel() for l in jax.tree_util.tree_leaves(g)])
+    ).all()
+
+
+def test_fused_dense_functions():
+    x = jax.random.normal(jax.random.PRNGKey(2), (5, 8))
+    w = jax.random.normal(jax.random.PRNGKey(3), (6, 8)) * 0.2
+    b = jnp.linspace(-1, 1, 6)
+    np.testing.assert_allclose(
+        np.asarray(fused_dense(x, w, b)), np.asarray(x @ w.T + b), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense_no_bias(x, w)), np.asarray(x @ w.T), atol=1e-5
+    )
+    w2 = jax.random.normal(jax.random.PRNGKey(4), (3, 6)) * 0.2
+    b2 = jnp.zeros((3,))
+    y = fused_dense_gelu_dense(x, w, b, w2, b2)
+    ref = jax.nn.gelu(x @ w.T + b, approximate=True) @ w2.T + b2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_dense_modules():
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8))
+    m = FusedDense(8, 4)
+    v = m.init(jax.random.PRNGKey(6), x)
+    assert m.apply(v, x).shape == (2, 4)
+
+    m2 = FusedDenseGeluDense(8, 16, 4)
+    v2 = m2.init(jax.random.PRNGKey(7), x)
+    assert m2.apply(v2, x).shape == (2, 4)
+
+
+# --- fp16_utils -------------------------------------------------------------
+
+def test_network_conversion_keeps_norms_fp32():
+    params = {
+        "dense": {"kernel": jnp.ones((3, 3)), "bias": jnp.zeros((3,))},
+        "bn_1": {"scale": jnp.ones((3,)), "bias": jnp.zeros((3,))},
+        "step": jnp.array(0, jnp.int32),
+    }
+    half = network_to_half(params)
+    assert half["dense"]["kernel"].dtype == jnp.bfloat16
+    assert half["bn_1"]["scale"].dtype == jnp.bfloat16  # network_to_half: all
+    assert half["step"].dtype == jnp.int32  # non-float untouched
+
+    conv = convert_network(params)
+    assert conv["dense"]["kernel"].dtype == jnp.bfloat16
+    assert conv["bn_1"]["scale"].dtype == jnp.float32  # norm kept fp32
+
+
+def test_master_param_roundtrip():
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    model_p, masters = prep_param_lists(params)
+    assert masters["w"].dtype == jnp.float32
+    masters = jax.tree_util.tree_map(lambda m: m + 0.25, masters)
+    back = master_params_to_model_params(model_p, masters)
+    assert back["w"].dtype == jnp.bfloat16
+    grads = model_grads_to_master_grads({"w": jnp.ones((4,), jnp.bfloat16)})
+    assert grads["w"].dtype == jnp.float32
+    assert to_python_float(jnp.float32(3.5)) == 3.5
+
+
+def test_fp16_optimizer_converges_and_skips_overflow():
+    opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+    params = {"w": jnp.ones((8,), jnp.bfloat16)}
+    state = opt.init(params)
+
+    def loss_fn(p):
+        return jnp.sum(p["w"].astype(jnp.float32) ** 2)
+
+    for _ in range(5):
+        grads = jax.grad(
+            lambda p: opt.scale_loss(state, loss_fn(p))
+        )(params)
+        params, state = opt.step(grads, state, params)
+    assert float(loss_fn(params)) < 8.0  # decreased from 8
+
+    # overflow injection: params unchanged, scale halved
+    before = np.asarray(state.masters["w"])
+    scale_before = float(state.scaler.loss_scale)
+    inf_grads = {"w": jnp.full((8,), jnp.inf, jnp.bfloat16)}
+    params, state = opt.step(inf_grads, state, params)
+    np.testing.assert_array_equal(np.asarray(state.masters["w"]), before)
+    assert float(state.scaler.loss_scale) == scale_before / 2
+
+    # checkpoint roundtrip
+    sd = opt.state_dict(state)
+    state2 = opt.load_state_dict(sd, state)
+    np.testing.assert_array_equal(
+        np.asarray(state2.masters["w"]), np.asarray(state.masters["w"])
+    )
+
+
+def test_fp16_optimizer_grad_clip():
+    opt = FP16_Optimizer(FusedSGD(lr=1.0))
+    grads = {"w": jnp.full((4,), 10.0)}
+    clipped = opt.clip_master_grads(grads, max_norm=1.0)
+    assert abs(float(jnp.linalg.norm(clipped["w"])) - 1.0) < 1e-4
+
+
+def test_dynamic_loss_scaler_legacy():
+    s = DynamicLossScaler(init_scale=16.0, scale_window=2)
+    assert not s.has_overflow({"g": jnp.ones(3)})
+    assert s.has_overflow({"g": jnp.array([1.0, jnp.inf])})
+    s.update_scale(True)
+    assert s.loss_scale == 8.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 16.0  # regrown after window
+
+
+# --- RNN --------------------------------------------------------------------
+
+@pytest.mark.parametrize("factory_name", ["LSTM", "GRU", "Tanh", "ReLU", "mLSTM"])
+def test_rnn_models_run_and_differentiate(factory_name):
+    import apex_tpu.RNN as RNNpkg
+
+    factory = getattr(RNNpkg, factory_name)
+    model = factory(input_size=5, hidden_size=7, num_layers=2)
+    params = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (6, 3, 5))  # [s, b, f]
+    y, finals = model(params, x)
+    assert y.shape == (6, 3, 7)
+    g = jax.grad(lambda p: jnp.sum(model(p, x)[0] ** 2))(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(bool(jnp.isfinite(l).all()) for l in leaves)
+    assert any(float(jnp.abs(l).max()) > 0 for l in leaves)
+
+
+def test_rnn_bidirectional_and_proj():
+    from apex_tpu.RNN import LSTM
+
+    model = LSTM(4, 6, 1, bidirectional=True, output_size=3, batch_first=True)
+    params = model.init(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 5, 4))  # [b, s, f]
+    y, _ = model(params, x)
+    assert y.shape == (2, 5, 3)
+
+
+def test_lstm_matches_manual_unroll():
+    from apex_tpu.RNN import LSTM
+    from apex_tpu.RNN.cells import LSTMCell
+
+    model = LSTM(3, 4, 1)
+    params = model.init(jax.random.PRNGKey(4))
+    x = jax.random.normal(jax.random.PRNGKey(5), (5, 2, 3))
+    y, _ = model(params, x)
+
+    cp = params["layers"][0][0]
+    h = jnp.zeros((2, 4))
+    c = jnp.zeros((2, 4))
+    outs = []
+    for t in range(5):
+        h, c = LSTMCell(cp, x[t], (h, c))
+        outs.append(h)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(jnp.stack(outs)), atol=1e-6
+    )

@@ -103,11 +103,7 @@ def test_pipeline_matches_sequential(pp_mesh):
         np.asarray(grads["b"]), np.asarray(ref_grads["b"]), atol=1e-5
     )
     # dinputs also matches the sequential model's input gradient
-    ref_dinp = jax.grad(
-        lambda inp: _sequential_reference(params, inp, targets, PP)[0]
-        if False
-        else _seq_loss(params, inp, targets)
-    )(inputs)
+    ref_dinp = jax.grad(lambda inp: _seq_loss(params, inp, targets))(inputs)
     np.testing.assert_allclose(np.asarray(dinp), np.asarray(ref_dinp), atol=1e-5)
 
 

@@ -221,7 +221,11 @@ def test_transformer_layers_ln_sp_tag():
     from apex_tpu.transformer.layers import FastLayerNorm, FusedLayerNorm
 
     ln = FusedLayerNorm(normalized_shape=8, sequence_parallel_enabled=True)
-    assert ln.sequence_parallel_param_names == ("scale", "bias")
+    assert ln.sequence_parallel_param_names == ("weight", "bias")
+    # the exported names match the actual flax param names
+    vars_probe = ln.init(jax.random.PRNGKey(7), jax.random.normal(
+        jax.random.PRNGKey(8), (2, 8)))
+    assert set(ln.sequence_parallel_param_names) == set(vars_probe["params"])
     ln2 = FastLayerNorm(normalized_shape=8)
     assert ln2.sequence_parallel_param_names == ()
     x = jax.random.normal(jax.random.PRNGKey(13), (4, 8))
