@@ -100,7 +100,7 @@ class _RNNModel:
         h = jnp.zeros((batch, self.hidden_size))
         return (h, jnp.zeros_like(h)) if self.is_lstm else h
 
-    def _run_dir(self, cell_params, x, reverse: bool):
+    def _run_dir(self, cell_params, x, reverse: bool, init_state=None):
         if reverse:
             x = jnp.flip(x, axis=0)
 
@@ -109,7 +109,9 @@ class _RNNModel:
             out = new_state[0] if self.is_lstm else new_state
             return new_state, out
 
-        final, outs = jax.lax.scan(step, self._zero_state(x.shape[1]), x)
+        if init_state is None:
+            init_state = self._zero_state(x.shape[1])
+        final, outs = jax.lax.scan(step, init_state, x)
         if reverse:
             outs = jnp.flip(outs, axis=0)
         return outs, final
@@ -118,16 +120,27 @@ class _RNNModel:
         self,
         params: Pytree,
         x: jax.Array,
+        initial_state=None,
         dropout_key: Optional[jax.Array] = None,
     ):
+        """``initial_state``: per-layer list of states ((h, c) tuples for
+        LSTM; (fwd, bwd) pairs when bidirectional); None = zeros."""
         if self.batch_first:
             x = jnp.swapaxes(x, 0, 1)
         finals = []
         h = x
         for layer, layer_params in enumerate(params["layers"]):
-            outs_f, fin_f = self._run_dir(layer_params[0], h, False)
+            layer_init = (
+                initial_state[layer] if initial_state is not None else None
+            )
+            init_f = init_b = None
+            if layer_init is not None:
+                init_f, init_b = (
+                    layer_init if self.bidirectional else (layer_init, None)
+                )
+            outs_f, fin_f = self._run_dir(layer_params[0], h, False, init_f)
             if self.bidirectional:
-                outs_b, fin_b = self._run_dir(layer_params[1], h, True)
+                outs_b, fin_b = self._run_dir(layer_params[1], h, True, init_b)
                 h = jnp.concatenate([outs_f, outs_b], axis=-1)
                 finals.append((fin_f, fin_b))
             else:

@@ -74,9 +74,18 @@ class FP16_Optimizer:
 
     @property
     def loss_scale(self):
-        """Reference property (``fp16_optimizer.py:547-556``) — note: on the
-        functional API read ``state.scaler.loss_scale`` instead."""
-        return self.loss_scaler
+        """The reference exposes the numeric scale here
+        (``fp16_optimizer.py:547-556``); on the functional API the scale
+        lives in the carried state, so this raises loudly instead of
+        returning a wrong type."""
+        raise RuntimeError(
+            "FP16_Optimizer is functional on TPU: read "
+            "state.scaler.loss_scale (a jax scalar) instead of "
+            "optimizer.loss_scale"
+        )
+
+    def get_loss_scale(self, state: FP16OptimizerState):
+        return state.scaler.loss_scale
 
     # -- step --------------------------------------------------------------
     def step(

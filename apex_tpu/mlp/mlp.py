@@ -38,8 +38,9 @@ def mlp(
 ) -> jax.Array:
     """Functional fused MLP (reference ``MlpFunction`` ``mlp.py:11-25``).
 
-    ``weights[i]`` is ``[out_i, in_i]`` (torch layout); activation is applied
-    after every layer except the last — matching ``mlp_cuda``'s semantics.
+    ``weights[i]`` is ``[out_i, in_i]`` (torch layout); the activation is
+    applied after EVERY layer, including the last — ``mlp_cuda``'s
+    semantics (its forward loop activates unconditionally per layer).
     """
     if activation not in _ACTIVATIONS:
         raise TypeError("activation must be relu or none or sigmoid")

@@ -124,7 +124,7 @@ def calc_params_l2_norm(params: Pytree, tp_duplicate_paths=(), axis_name=None):
 
 def allreduce_sequence_parallel_grads(
     grads: Pytree,
-    param_names: Sequence[str] = ("weight", "bias"),
+    is_sequence_parallel_param,
     axis_name: Optional[str] = None,
 ) -> Pytree:
     """All-reduce grads of sequence-parallel-replicated params over TP.
@@ -134,17 +134,21 @@ def allreduce_sequence_parallel_grads(
     grads must be summed across the TP group — the grad-sync loop the
     reference runs over params tagged ``sequence_parallel_enabled``
     (``apex/transformer/layers/layer_norm.py:26-50`` tagging; consumed by
-    Megatron-style trainers). Grads whose path contains any of
-    ``param_names`` (the names exported by
-    ``transformer.layers.FusedLayerNorm.sequence_parallel_param_names``)
-    are psum'd over ``axis_name``; call inside shard_map.
+    Megatron-style trainers).
+
+    ``is_sequence_parallel_param`` is a REQUIRED predicate over the
+    flattened key-path string (e.g. ``lambda p: "_ln_" in p`` for the
+    standalone GPT's layernorm naming, or a closure over your modules'
+    ``sequence_parallel_param_names``). It is deliberately not defaulted:
+    generic name matching ("weight"/"bias") would psum grads of ordinary
+    dense layers and silently corrupt the step.
     """
     a = axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
     flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
     out = []
     for path, leaf in flat:
         pstr = jax.tree_util.keystr(path)
-        if any(name in pstr for name in param_names):
+        if is_sequence_parallel_param(pstr):
             out.append(jax.lax.psum(leaf, a))
         else:
             out.append(leaf)

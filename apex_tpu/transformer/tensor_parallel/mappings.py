@@ -1,4 +1,4 @@
-"""Tensor-parallel autograd collectives (+ sequence-parallel variants).
+"""Tensor-parallel collectives (+ sequence-parallel variants).
 
 Reference: ``apex/transformer/tensor_parallel/mappings.py`` — eight
 autograd.Functions pairing a forward collective with its backward dual:
@@ -15,16 +15,25 @@ gather_from_sequence_parallel (all-gather seq) ``:231``  reduce-scatter seq
 reduce_scatter_to_sequence_parallel   ``:253``        all-gather seq dim
 ====================================================  =====================
 
-TPU-native: each is a ``jax.custom_vjp`` over ``jax.lax`` collectives
-(``psum`` / ``all_gather`` / ``psum_scatter`` / dynamic-slice split) bound to
-a named mesh axis, to be used inside ``shard_map``. The custom VJPs make the
-forward/backward pairing explicit rather than relying on collective
-transposition rules. Sequence-parallel functions operate on dim 0 (the
-``[s, b, h]`` Megatron layout); TP functions on the last dim.
+TPU-native: the CUDA reference must hand-write each backward because
+``torch.autograd`` knows nothing about NCCL calls. JAX's collective
+primitives already carry the correct transposes **under shard_map's varying
+-manual-axes (vma) tracking** (``check_vma=True``, the default):
+
+- a replicated value flowing into device-varying compute transposes to a
+  psum of the partial cotangents — exactly ``copy``'s all-reduce backward,
+  inserted automatically (hand-psum'ing in a custom_vjp double-counts!);
+- ``psum``'s transpose is the identity broadcast (``reduce`` backward);
+- ``all_gather``'s transpose is ``psum_scatter`` and vice versa — the
+  gather/scatter and sequence-parallel pairings.
+
+So the functions below are *plain differentiable code*; the table's dual
+structure falls out of autodiff. They must run inside ``shard_map`` with
+``check_vma=True`` (with ``check_vma=False`` JAX transposes psum to psum,
+over-counting by the axis size — don't differentiate TP code in that mode).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -39,7 +48,7 @@ def _axis(axis_name: Optional[str]) -> str:
 
 def _split_along_dim(x: jax.Array, axis_name: str, dim: int) -> jax.Array:
     """Keep this rank's 1/world slice of ``x`` along ``dim``
-    (reference ``mappings.py:63-80`` ``_split_along_last_dim``)."""
+    (reference ``mappings.py:63-80``). Transposes to an all-gather."""
     world = jax.lax.axis_size(axis_name)  # static
     rank = jax.lax.axis_index(axis_name)
     # divisibility guard (reference utils.py ensure_divisibility)
@@ -60,133 +69,52 @@ def _reduce_scatter_dim(x: jax.Array, axis_name: str, dim: int) -> jax.Array:
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 
-# --- copy: identity fwd / all-reduce bwd (mappings.py:141) -------------------
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def copy_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    """Identity forward / all-reduce backward (reference ``mappings.py:141``).
+
+    Under vma tracking the all-reduce backward is JAX's transpose of the
+    replicated→varying broadcast, so the forward is literally the identity.
+    """
+    del axis_name
     return x
 
 
-def _copy_fwd(x, axis_name):
-    return x, None
-
-
-def _copy_bwd(axis_name, _, g):
-    return (jax.lax.psum(g, _axis(axis_name)),)
-
-
-copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
-
-
-# --- reduce: all-reduce fwd / identity bwd (mappings.py:159) -----------------
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    """All-reduce forward / identity backward (reference ``mappings.py:159``)."""
     return jax.lax.psum(x, _axis(axis_name))
 
 
-def _reduce_fwd(x, axis_name):
-    return jax.lax.psum(x, _axis(axis_name)), None
-
-
-def _reduce_bwd(axis_name, _, g):
-    return (g,)
-
-
-reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
-
-
-# --- scatter: split-last-dim fwd / all-gather bwd (mappings.py:177) ----------
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    """Split-last-dim forward / all-gather backward (``mappings.py:177``)."""
     return _split_along_dim(x, _axis(axis_name), x.ndim - 1)
 
 
-def _scatter_fwd(x, axis_name):
-    return _split_along_dim(x, _axis(axis_name), x.ndim - 1), None
-
-
-def _scatter_bwd(axis_name, _, g):
-    return (_all_gather_dim(g, _axis(axis_name), g.ndim - 1),)
-
-
-scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
-
-
-# --- gather: all-gather-last-dim fwd / split bwd (mappings.py:195) -----------
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    """All-gather-last-dim forward / split backward (``mappings.py:195``)."""
     return _all_gather_dim(x, _axis(axis_name), x.ndim - 1)
 
 
-def _gather_fwd(x, axis_name):
-    return _all_gather_dim(x, _axis(axis_name), x.ndim - 1), None
-
-
-def _gather_bwd(axis_name, _, g):
-    return (_split_along_dim(g, _axis(axis_name), g.ndim - 1),)
-
-
-gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
-
-
-# --- sequence-parallel collectives (dim 0 of [s, b, h]) ----------------------
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
     """Split along the sequence dim (reference ``mappings.py:213-228``)."""
     return _split_along_dim(x, _axis(axis_name), 0)
 
 
-def _seq_scatter_fwd(x, axis_name):
-    return _split_along_dim(x, _axis(axis_name), 0), None
-
-
-def _seq_scatter_bwd(axis_name, _, g):
-    return (_all_gather_dim(g, _axis(axis_name), 0),)
-
-
-scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def gather_from_sequence_parallel_region(
     x, axis_name: Optional[str] = None, to_model_parallel: bool = True
 ):
     """All-gather along sequence dim; backward reduce-scatters (the SP
-    linear-layer pairing, reference ``mappings.py:231-250``) or plain-splits
-    when ``to_model_parallel=False`` (embedding path)."""
+    linear-layer pairing, reference ``mappings.py:231-250``) — which is
+    ``all_gather``'s JAX transpose. ``to_model_parallel`` selects the
+    embedding-path variant in the reference whose backward is a plain
+    split; that distinction is vma bookkeeping here (both transposes are
+    psum_scatter; for a cotangent that is identical across ranks the
+    reduce-scatter of 1/world-scaled contributions equals the split), so
+    the flag is accepted for parity."""
+    del to_model_parallel
     return _all_gather_dim(x, _axis(axis_name), 0)
 
 
-def _seq_gather_fwd(x, axis_name, to_model_parallel):
-    return _all_gather_dim(x, _axis(axis_name), 0), None
-
-
-def _seq_gather_bwd(axis_name, to_model_parallel, _, g):
-    a = _axis(axis_name)
-    if to_model_parallel:
-        return (_reduce_scatter_dim(g, a, 0),)
-    return (_split_along_dim(g, a, 0),)
-
-
-gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
-    """Reduce-scatter along sequence dim (reference ``mappings.py:253-268``)."""
+    """Reduce-scatter along sequence dim (reference ``mappings.py:253-268``);
+    transposes to the all-gather."""
     return _reduce_scatter_dim(x, _axis(axis_name), 0)
-
-
-def _seq_rs_fwd(x, axis_name):
-    return _reduce_scatter_dim(x, _axis(axis_name), 0), None
-
-
-def _seq_rs_bwd(axis_name, _, g):
-    return (_all_gather_dim(g, _axis(axis_name), 0),)
-
-
-reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

@@ -1,0 +1,193 @@
+"""Megatron-style argument parser.
+
+Reference: ``apex/transformer/testing/arguments.py`` (977 LoC) — the full
+Megatron flag surface used by the test/benchmark harnesses. This port keeps
+the flags the TPU harnesses consume (model shape, TP/PP/SP sizes, precision,
+batching, recompute, loss scale, optimizer) plus validation mirroring
+``parse_args``'s consistency checks; CUDA-only knobs (``--ddp-impl``,
+NCCL/IB tuning, fused-kernel build flags) are accepted and ignored so
+reference command lines keep working.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+import jax
+
+
+def parse_args(
+    extra_args_provider=None,
+    defaults: Optional[dict] = None,
+    ignore_unknown_args: bool = True,
+    args: Optional[List[str]] = None,
+):
+    """Reference ``arguments.py:parse_args`` — returns a validated namespace."""
+    parser = argparse.ArgumentParser(
+        description="apex_tpu Megatron-style arguments", allow_abbrev=False
+    )
+    _add_network_size_args(parser)
+    _add_training_args(parser)
+    _add_regularization_args(parser)
+    _add_mixed_precision_args(parser)
+    _add_distributed_args(parser)
+    _add_data_args(parser)
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        namespace, _ = parser.parse_known_args(args)
+    else:
+        namespace = parser.parse_args(args)
+
+    if defaults:
+        for k, v in defaults.items():
+            if getattr(namespace, k, None) is None:
+                setattr(namespace, k, v)
+
+    return validate_args(namespace)
+
+
+def validate_args(args):
+    """Consistency checks mirroring reference ``arguments.py`` validation."""
+    world = args.world_size or len(jax.devices())
+    args.world_size = world
+    model_parallel = (
+        args.tensor_model_parallel_size * args.pipeline_model_parallel_size
+    )
+    if world % model_parallel != 0:
+        raise ValueError(
+            f"world size ({world}) is not divisible by tensor "
+            f"({args.tensor_model_parallel_size}) x pipeline "
+            f"({args.pipeline_model_parallel_size}) parallel sizes"
+        )
+    args.data_parallel_size = world // model_parallel
+
+    if args.fp16 and args.bf16:
+        raise ValueError("cannot specify both fp16 and bf16")
+    args.params_dtype = "float32"
+    if args.fp16:
+        args.params_dtype = "float16"
+    if args.bf16:
+        args.params_dtype = "bfloat16"
+
+    if args.ffn_hidden_size is None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    if args.kv_channels is None:
+        if args.hidden_size % args.num_attention_heads != 0:
+            raise ValueError("hidden size must be divisible by attention heads")
+        args.kv_channels = args.hidden_size // args.num_attention_heads
+
+    if args.seq_length is not None and args.max_position_embeddings is not None:
+        if args.max_position_embeddings < args.seq_length:
+            raise ValueError(
+                "max_position_embeddings must be at least seq_length"
+            )
+    if args.sequence_parallel and args.tensor_model_parallel_size == 1:
+        # SP without TP is a no-op; the reference asserts similarly
+        args.sequence_parallel = False
+    if (
+        args.virtual_pipeline_model_parallel_size is not None
+        and args.pipeline_model_parallel_size <= 2
+    ):
+        raise ValueError(
+            "interleaved schedule requires pipeline size > 2"
+        )
+    return args
+
+
+def _add_network_size_args(parser):
+    group = parser.add_argument_group(title="network size")
+    group.add_argument("--num-layers", type=int, default=None)
+    group.add_argument("--hidden-size", type=int, default=None)
+    group.add_argument("--ffn-hidden-size", type=int, default=None)
+    group.add_argument("--num-attention-heads", type=int, default=None)
+    group.add_argument("--kv-channels", type=int, default=None)
+    group.add_argument("--max-position-embeddings", type=int, default=None)
+    group.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    group.add_argument("--vocab-size", type=int, default=None)
+    group.add_argument(
+        "--apply-query-key-layer-scaling", action="store_true", default=True
+    )
+    return parser
+
+
+def _add_training_args(parser):
+    group = parser.add_argument_group(title="training")
+    group.add_argument("--micro-batch-size", type=int, default=None)
+    group.add_argument("--global-batch-size", type=int, default=None)
+    group.add_argument("--rampup-batch-size", nargs="*", default=None)
+    group.add_argument("--train-iters", type=int, default=None)
+    group.add_argument("--lr", type=float, default=None)
+    group.add_argument("--min-lr", type=float, default=0.0)
+    group.add_argument("--lr-decay-style", type=str, default="linear",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--lr-warmup-fraction", type=float, default=None)
+    group.add_argument("--optimizer", type=str, default="adam",
+                       choices=["adam", "sgd", "lamb"])
+    group.add_argument("--adam-beta1", type=float, default=0.9)
+    group.add_argument("--adam-beta2", type=float, default=0.999)
+    group.add_argument("--adam-eps", type=float, default=1e-8)
+    group.add_argument("--clip-grad", type=float, default=1.0)
+    group.add_argument(
+        "--recompute-granularity", type=str, default=None,
+        choices=["full", "selective"],
+    )
+    group.add_argument("--recompute-method", type=str, default=None,
+                       choices=["uniform", "block"])
+    group.add_argument("--recompute-num-layers", type=int, default=1)
+    group.add_argument("--cpu-offload", action="store_true",
+                       help="fork-added activation offload to host")
+    return parser
+
+
+def _add_regularization_args(parser):
+    group = parser.add_argument_group(title="regularization")
+    group.add_argument("--attention-dropout", type=float, default=0.1)
+    group.add_argument("--hidden-dropout", type=float, default=0.1)
+    group.add_argument("--weight-decay", type=float, default=0.01)
+    return parser
+
+
+def _add_mixed_precision_args(parser):
+    group = parser.add_argument_group(title="mixed precision")
+    group.add_argument("--fp16", action="store_true")
+    group.add_argument("--bf16", action="store_true")
+    group.add_argument("--loss-scale", type=float, default=None)
+    group.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    group.add_argument("--min-loss-scale", type=float, default=1.0)
+    group.add_argument("--loss-scale-window", type=float, default=1000)
+    group.add_argument("--hysteresis", type=int, default=2)
+    return parser
+
+
+def _add_distributed_args(parser):
+    group = parser.add_argument_group(title="distributed")
+    group.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    group.add_argument(
+        "--virtual-pipeline-model-parallel-size", type=int, default=None
+    )
+    group.add_argument(
+        "--pipeline-model-parallel-split-rank", type=int, default=None
+    )
+    group.add_argument("--sequence-parallel", action="store_true")
+    group.add_argument("--world-size", type=int, default=None)
+    group.add_argument("--rank", type=int, default=0)
+    group.add_argument("--local-rank", type=int, default=0)
+    # CUDA-only knobs accepted for command-line parity (ignored):
+    group.add_argument("--DDP-impl", type=str, default="local")
+    group.add_argument("--use-cpu-initialization", action="store_true")
+    group.add_argument("--distributed-backend", type=str, default="xla")
+    return parser
+
+
+def _add_data_args(parser):
+    group = parser.add_argument_group(title="data")
+    group.add_argument("--seq-length", type=int, default=None)
+    group.add_argument("--encoder-seq-length", type=int, default=None)
+    group.add_argument("--decoder-seq-length", type=int, default=None)
+    group.add_argument("--num-workers", type=int, default=2)
+    group.add_argument("--seed", type=int, default=1234)
+    return parser

@@ -1,0 +1,86 @@
+"""Shared test fixtures.
+
+Reference: ``apex/transformer/testing/commons.py`` — toy models, forward
+step fixtures, ``set_random_seed`` (``:242``), ``initialize_distributed``
+(``:250``), print helpers.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import parallel_state
+from ..tensor_parallel import model_parallel_manual_seed
+
+Pytree = Any
+
+TEST_SUCCESS_MESSAGE = ">> passed the test :-)"
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Seed python/numpy and the model-parallel RNG tracker; returns a JAX
+    key (reference ``commons.py:242-248``)."""
+    random.seed(seed)
+    np.random.seed(seed)
+    model_parallel_manual_seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def initialize_distributed(backend: str = "tpu") -> None:
+    """Single-controller analogue of the reference's process-group setup
+    (``commons.py:250-287``): multi-host JAX init from env if configured;
+    otherwise a no-op (all local devices already visible)."""
+    del backend
+    if os.environ.get("COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+
+def print_separator(message: str) -> None:
+    """Reference ``commons.py:233-239``."""
+    filler_len = (78 - len(message)) // 2
+    filler = "-" * filler_len
+    string = "\n" + filler + " {} ".format(message) + filler
+    if jax.process_index() == 0:
+        print(string, flush=True)
+
+
+# --- toy models (reference commons.py:44-130) -------------------------------
+
+def identity_layer(shape, key):
+    """IdentityLayer analogue: a trainable tensor returned as-is."""
+    return jax.random.normal(key, shape)
+
+
+def toy_mlp_stage(hidden: int, key: jax.Array, n_stages: int = 1):
+    """Per-stage toy MLP params (the ``MyLayer``/``MyModel`` of
+    ``commons.py:73-130``) for pipeline schedule tests."""
+    keys = jax.random.split(key, n_stages)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (hidden, hidden)) * 0.5 for k in keys]),
+        "b": jnp.zeros((n_stages, hidden)),
+    }
+
+
+def toy_stage_fn(params: Pytree, x: jax.Array) -> jax.Array:
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def toy_loss_fn(y: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean((y - target) ** 2)
+
+
+def fwd_step_func(batch, model_fn, params):
+    """Reference ``fwd_step_func`` (``commons.py:192-202``): returns
+    (output, loss_reducer)."""
+    output = model_fn(params, batch)
+
+    def loss_func(output):
+        loss = jnp.sum(output)
+        return loss, {"avg": loss}
+
+    return output, loss_func

@@ -1,0 +1,58 @@
+"""Distributed test base.
+
+Reference: ``apex/transformer/testing/distributed_test_base.py:22-131`` —
+``DistributedTestBase`` subclasses torch's ``MultiProcessTestCase`` to spawn
+one process per GPU on a single node, with NCCL and UCC variants.
+
+TPU-native: SPMD needs no process spawning — the analogue is a unittest
+base that materialises an N-virtual-device mesh (the conftest forces
+``xla_force_host_platform_device_count``) and tears parallel_state down
+between tests. ``NcclDistributedTestBase``/``UccDistributedTestBase``
+collapse into this single class (backend selection has no meaning on a
+mesh) and are aliased for test-code parity.
+"""
+from __future__ import annotations
+
+import unittest
+from typing import Optional
+
+import jax
+
+from .. import parallel_state
+
+
+class DistributedTestBase(unittest.TestCase):
+    """Mesh-based analogue of the reference's multi-process test base."""
+
+    #: cap matching the reference's ``world_size = min(#GPUs, 4)`` default
+    #: (``distributed_test_base.py:38``); None = all devices
+    MAX_WORLD_SIZE: Optional[int] = None
+
+    @property
+    def world_size(self) -> int:
+        n = len(jax.devices())
+        if self.MAX_WORLD_SIZE is not None:
+            n = min(n, self.MAX_WORLD_SIZE)
+        return n
+
+    def setUp(self) -> None:
+        super().setUp()
+        parallel_state.destroy_model_parallel()
+
+    def tearDown(self) -> None:
+        parallel_state.destroy_model_parallel()
+        super().tearDown()
+
+    def initialize_model_parallel(self, tp=1, pp=1, vpp=None, **kwargs):
+        return parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=tp,
+            pipeline_model_parallel_size_=pp,
+            virtual_pipeline_model_parallel_size_=vpp,
+            devices=jax.devices()[: self.world_size],
+            **kwargs,
+        )
+
+
+# backend variants collapse on TPU; aliases keep reference test code working
+NcclDistributedTestBase = DistributedTestBase
+UccDistributedTestBase = DistributedTestBase

@@ -1,0 +1,58 @@
+"""Standalone BERT model for tests and benchmarks.
+
+Reference: ``apex/transformer/testing/standalone_bert.py`` — Megatron BERT
+(bidirectional encoder, MLM + binary heads) built on the standalone
+transformer LM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .standalone_transformer_lm import (  # noqa: F401
+    GPTConfig,
+    bert_forward,
+    init_gpt_params,
+)
+
+Pytree = Any
+
+
+def bert_model_provider(cfg: GPTConfig, key: jax.Array):
+    """Return ``(params, forward_fn, loss_fn)`` for the test BERT
+    (reference ``bert_model_provider``)."""
+    params = init_gpt_params(cfg, key)
+    fwd = functools.partial(bert_forward, cfg)
+
+    def loss_fn(
+        params, tokens, labels, loss_mask, padding_mask=None,
+        binary_labels=None, axis_name=None, dropout_key=None,
+        deterministic=True,
+    ):
+        lm_logits, binary_logits = fwd(
+            params, tokens, padding_mask, axis_name, dropout_key,
+            deterministic,
+        )
+        if axis_name is not None:
+            from ..tensor_parallel import vocab_parallel_cross_entropy
+
+            losses = vocab_parallel_cross_entropy(
+                lm_logits, labels, 0.0, axis_name
+            )
+        else:
+            logp = jax.nn.log_softmax(lm_logits.astype(jnp.float32), -1)
+            losses = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+        m = loss_mask.astype(jnp.float32)
+        lm_loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        if binary_logits is not None and binary_labels is not None:
+            logp2 = jax.nn.log_softmax(binary_logits.astype(jnp.float32), -1)
+            sop = -jnp.mean(
+                jnp.take_along_axis(logp2, binary_labels[..., None], -1)
+            )
+            return lm_loss + sop
+        return lm_loss
+
+    return params, fwd, loss_fn

@@ -1,0 +1,78 @@
+"""Standalone GPT model for tests and benchmarks.
+
+Reference: ``apex/transformer/testing/standalone_gpt.py`` — builds the
+Megatron GPT from the standalone transformer LM, with the fork-added
+``cpu_offload`` option that wraps the forward in
+``torch.autograd.graph.save_on_cpu`` (``standalone_gpt.py:59-61,:96``).
+
+TPU-native: ``cpu_offload=True`` maps to ``jax.checkpoint`` with the
+``save_and_offload_only_these_names`` offload policy when available (saved
+residuals placed in host memory), otherwise plain rematerialisation — the
+same memory/time trade the reference's save_on_cpu makes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .standalone_transformer_lm import (  # noqa: F401
+    GPTConfig,
+    gpt_forward,
+    gpt_loss,
+    gpt_partition_specs,
+    init_gpt_params,
+)
+
+Pytree = Any
+
+
+def gpt_model_provider(
+    cfg: GPTConfig,
+    key: jax.Array,
+    cpu_offload: bool = False,
+    pre_process: bool = True,
+    post_process: bool = True,
+):
+    """Return ``(params, forward_fn, loss_fn)`` for the test GPT
+    (reference ``gpt_model_provider`` / ``GPTModel`` wiring).
+
+    ``pre_process``/``post_process`` mirror the reference's pipeline-stage
+    flags; with the scan-based stage functions those are handled by the
+    schedule (embedding/head run outside the pipelined body), so they are
+    accepted for parity.
+    """
+    del pre_process, post_process
+    params = init_gpt_params(cfg, key)
+
+    fwd = functools.partial(gpt_forward, cfg)
+    loss = functools.partial(gpt_loss, cfg)
+    if cpu_offload:
+        fwd = _offloaded(fwd)
+        loss = _offloaded(loss)
+    return params, fwd, loss
+
+
+def _offloaded(fn):
+    """Wrap in remat with host-offload of saved activations when the backend
+    supports it (the ``save_on_cpu`` analogue). The capability check probes
+    the device's memory spaces up front — policy construction itself never
+    fails, the error would otherwise only surface at trace time."""
+    if _has_host_memory_space():
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
+        return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn)
+
+
+def _has_host_memory_space() -> bool:
+    try:
+        kinds = {
+            m.kind for m in jax.local_devices()[0].addressable_memories()
+        }
+        return "pinned_host" in kinds
+    except Exception:
+        return False

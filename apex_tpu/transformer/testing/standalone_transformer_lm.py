@@ -1,0 +1,525 @@
+"""Standalone Megatron-style transformer LM, TPU-native.
+
+Reference: ``apex/transformer/testing/standalone_transformer_lm.py`` (1574
+LoC) — the in-repo Megatron-LM clone used by the transformer test suite and
+GPT/BERT scaling harnesses: ``ParallelMLP`` (``:89``), ``ParallelAttention``
+(``:210``), ``ParallelTransformerLayer``, ``ParallelTransformer``,
+embeddings, and ``post_language_model_processing`` heads.
+
+TPU-native design: the model is a pure function over an explicit parameter
+pytree in the Megatron ``[s, b, h]`` layout, built from the
+``tensor_parallel`` functional cores. Two execution modes share one code
+path:
+
+- ``axis_name=None`` — dense single-device math (weights global);
+- ``axis_name="tensor"`` — inside ``shard_map``; weights are the local TP
+  shards and the collectives come from ``tensor_parallel.mappings``.
+
+Layer weights are *stacked* ``[L, ...]`` and the layer loop is a
+``lax.scan`` (one compiled layer body regardless of depth — the XLA
+equivalent of Megatron reusing one CUDA graph per layer), with optional
+rematerialisation. Pipeline stages slice the layer stack; the partition
+specs for every weight are exported for pjit/shard_map wiring.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import parallel_state
+from ..enums import AttnMaskType
+from ..functional.fused_softmax import FusedScaleMaskSoftmax
+from ..tensor_parallel import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+from ..tensor_parallel import mappings
+from ...ops.layer_norm import layer_norm as fused_layer_norm
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    """Model shape config (the relevant subset of the reference's
+    ``testing/arguments.py`` Megatron flag surface)."""
+
+    num_layers: int = 4
+    hidden_size: int = 64
+    num_attention_heads: int = 4
+    vocab_size: int = 512
+    max_position_embeddings: int = 128
+    ffn_hidden_size: Optional[int] = None  # default 4h
+    layernorm_epsilon: float = 1e-5
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32  # bf16 for mixed precision
+    tensor_model_parallel_size: int = 1
+    sequence_parallel: bool = False
+    apply_query_key_layer_scaling: bool = True
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    recompute_granularity: Optional[str] = None  # None | "full"
+    # BERT extras
+    add_binary_head: bool = False
+
+    @property
+    def ffn_size(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def kv_channels(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def init_gpt_params(cfg: GPTConfig, key: jax.Array) -> Pytree:
+    """Global (unsharded) parameter pytree.
+
+    Init scheme mirrors Megatron (reference ``standalone_transformer_lm.py``
+    init helpers): normal(0, 0.02) for weights, scaled by
+    ``1/sqrt(2*num_layers)`` for output projections, zeros for biases, ones
+    for LN weights.
+    """
+    h, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    ffn = cfg.ffn_size
+    k = jax.random.split(key, 8)
+    std = 0.02
+    out_std = std / (2.0 * L) ** 0.5
+    dt = cfg.params_dtype
+
+    def n(kk, shape, s=std):
+        return (jax.random.normal(kk, shape) * s).astype(dt)
+
+    kl = jax.random.split(k[7], 6)
+    params = {
+        "embedding": {
+            "word": n(k[0], (v, h)),
+            "position": n(k[1], (cfg.max_position_embeddings, h)),
+        },
+        "layers": {
+            "input_ln_w": jnp.ones((L, h), dt),
+            "input_ln_b": jnp.zeros((L, h), dt),
+            "qkv_w": n(kl[0], (L, 3 * h, h)),
+            "qkv_b": jnp.zeros((L, 3 * h), dt),
+            "proj_w": n(kl[1], (L, h, h), out_std),
+            "proj_b": jnp.zeros((L, h), dt),
+            "post_ln_w": jnp.ones((L, h), dt),
+            "post_ln_b": jnp.zeros((L, h), dt),
+            "fc1_w": n(kl[2], (L, ffn, h)),
+            "fc1_b": jnp.zeros((L, ffn), dt),
+            "fc2_w": n(kl[3], (L, h, ffn), out_std),
+            "fc2_b": jnp.zeros((L, h), dt),
+        },
+        "final_ln_w": jnp.ones((h,), dt),
+        "final_ln_b": jnp.zeros((h,), dt),
+    }
+    if cfg.add_binary_head:
+        params["binary_head"] = {
+            "pooler_w": n(k[2], (h, h)),
+            "pooler_b": jnp.zeros((h,), dt),
+            "head_w": n(k[3], (2, h)),
+            "head_b": jnp.zeros((2,), dt),
+        }
+    return params
+
+
+def gpt_partition_specs(cfg: GPTConfig) -> Pytree:
+    """PartitionSpec per parameter for the TP mesh axis (Megatron sharding:
+    column weights row-sharded, row weights column-sharded, vocab sharded,
+    LN replicated)."""
+    t = parallel_state.TENSOR_AXIS
+    specs = {
+        "embedding": {"word": P(t, None), "position": P()},
+        "layers": {
+            "input_ln_w": P(), "input_ln_b": P(),
+            "qkv_w": P(None, t, None), "qkv_b": P(None, t),
+            "proj_w": P(None, None, t), "proj_b": P(),
+            "post_ln_w": P(), "post_ln_b": P(),
+            "fc1_w": P(None, t, None), "fc1_b": P(None, t),
+            "fc2_w": P(None, None, t), "fc2_b": P(),
+        },
+        "final_ln_w": P(), "final_ln_b": P(),
+    }
+    if cfg.add_binary_head:
+        specs["binary_head"] = {
+            "pooler_w": P(), "pooler_b": P(), "head_w": P(), "head_b": P(),
+        }
+    return specs
+
+
+# --------------------------------------------------------------------------
+# blocks
+# --------------------------------------------------------------------------
+
+def _dropout(x, rate, key, deterministic):
+    if deterministic or rate == 0.0 or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+
+
+def parallel_attention(
+    cfg: GPTConfig,
+    lp: Dict[str, jax.Array],
+    hidden: jax.Array,  # [s, b, h]
+    attention_mask: Optional[jax.Array],
+    axis_name: Optional[str],
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+    layer_number: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Self-attention (reference ``ParallelAttention``
+    ``standalone_transformer_lm.py:210-400``): column-parallel fused QKV,
+    head-parallel scaled-masked softmax, row-parallel output projection."""
+    s, b, _ = hidden.shape
+    tp = cfg.tensor_model_parallel_size if axis_name is not None else 1
+    np_local = cfg.num_attention_heads // tp
+    hn = cfg.kv_channels
+
+    if axis_name is not None:
+        qkv, _ = column_parallel_linear(
+            hidden, lp["qkv_w"].astype(hidden.dtype),
+            lp["qkv_b"].astype(hidden.dtype), axis_name=axis_name,
+            gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+        )
+    else:
+        qkv = (jnp.einsum("sbh,oh->sbo", hidden, lp["qkv_w"].astype(hidden.dtype))
+               + lp["qkv_b"].astype(hidden.dtype))
+
+    qkv = qkv.reshape(s, b, np_local, 3 * hn)
+    q, kk, vv = jnp.split(qkv, 3, axis=-1)  # [s, b, np, hn]
+
+    # fp16 query-key layer scaling (reference coeff trick): divide scores
+    # by the 1-based layer number before any fp16 cast and multiply back
+    # inside the fp32 softmax, so deep-layer fp16 scores cannot overflow
+    qk_scaling = (
+        cfg.apply_query_key_layer_scaling
+        and cfg.compute_dtype == jnp.float16
+        and layer_number is not None
+    )
+    norm_factor = hn ** 0.5
+    coeff = None
+    if qk_scaling:
+        coeff = jnp.maximum(layer_number.astype(jnp.float32), 1.0)
+        norm_factor = norm_factor * coeff
+    scores = jnp.einsum(
+        "sbnh,tbnh->bnst", q, kk, preferred_element_type=jnp.float32
+    ) / norm_factor
+
+    if coeff is not None:
+        # traced scale: inline fp32 softmax (the Pallas kernel needs a
+        # static scale; fp16+layer-scaling takes the XLA path)
+        x = scores * coeff
+        if cfg.attn_mask_type == AttnMaskType.causal:
+            qi = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 0)
+            ki = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 1)
+            x = jnp.where(ki > qi, -10000.0, x)
+        elif attention_mask is not None:
+            x = jnp.where(attention_mask != 0, -10000.0, x)
+        probs = jax.nn.softmax(x, axis=-1).astype(cfg.compute_dtype)
+    else:
+        softmax = FusedScaleMaskSoftmax(
+            input_in_fp16=(cfg.compute_dtype == jnp.float16),
+            input_in_bf16=(cfg.compute_dtype == jnp.bfloat16),
+            attn_mask_type=cfg.attn_mask_type,
+            mask_func=None,
+            softmax_in_fp32=True,
+            scale=None,
+        )
+        probs = softmax(scores.astype(cfg.compute_dtype), attention_mask)
+
+    if dropout_key is not None:
+        dropout_key, sub = jax.random.split(dropout_key)
+        probs = _dropout(probs, cfg.attention_dropout, sub, deterministic)
+
+    ctx = jnp.einsum(
+        "bnst,tbnh->sbnh", probs.astype(vv.dtype), vv,
+        preferred_element_type=jnp.float32,
+    ).astype(hidden.dtype)
+    ctx = ctx.reshape(s, b, np_local * hn)
+
+    if axis_name is not None:
+        out, _ = row_parallel_linear(
+            ctx, lp["proj_w"].astype(ctx.dtype),
+            lp["proj_b"].astype(ctx.dtype), axis_name=axis_name,
+            input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+        )
+    else:
+        out = (jnp.einsum("sbo,ho->sbh", ctx, lp["proj_w"].astype(ctx.dtype))
+               + lp["proj_b"].astype(ctx.dtype))
+    return out
+
+
+def parallel_mlp(
+    cfg: GPTConfig,
+    lp: Dict[str, jax.Array],
+    hidden: jax.Array,
+    axis_name: Optional[str],
+) -> jax.Array:
+    """Reference ``ParallelMLP`` (``standalone_transformer_lm.py:89-130``):
+    column-parallel h→4h, fused bias-GeLU, row-parallel 4h→h."""
+    if axis_name is not None:
+        inter, _ = column_parallel_linear(
+            hidden, lp["fc1_w"].astype(hidden.dtype),
+            lp["fc1_b"].astype(hidden.dtype), axis_name=axis_name,
+            gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+        )
+        inter = jax.nn.gelu(inter, approximate=True)
+        out, _ = row_parallel_linear(
+            inter, lp["fc2_w"].astype(inter.dtype),
+            lp["fc2_b"].astype(inter.dtype), axis_name=axis_name,
+            input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+        )
+        return out
+    inter = (jnp.einsum("sbh,oh->sbo", hidden, lp["fc1_w"].astype(hidden.dtype))
+             + lp["fc1_b"].astype(hidden.dtype))
+    inter = jax.nn.gelu(inter, approximate=True)
+    return (jnp.einsum("sbo,ho->sbh", inter, lp["fc2_w"].astype(hidden.dtype))
+            + lp["fc2_b"].astype(hidden.dtype))
+
+
+def transformer_layer(
+    cfg: GPTConfig,
+    lp: Dict[str, jax.Array],
+    hidden: jax.Array,
+    attention_mask: Optional[jax.Array],
+    axis_name: Optional[str],
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+    layer_number: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pre-LN transformer layer (reference ``ParallelTransformerLayer``)."""
+    dt = hidden.dtype
+    k1 = k2 = k3 = None
+    if dropout_key is not None:
+        k1, k2, k3 = jax.random.split(dropout_key, 3)
+
+    ln1 = fused_layer_norm(
+        hidden.astype(jnp.float32), lp["input_ln_w"].astype(jnp.float32),
+        lp["input_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
+    ).astype(dt)
+    attn = parallel_attention(
+        cfg, lp, ln1, attention_mask, axis_name, k1, deterministic,
+        layer_number,
+    )
+    hidden = (hidden + _dropout(attn, cfg.hidden_dropout, k3,
+                               deterministic)).astype(dt)
+
+    ln2 = fused_layer_norm(
+        hidden.astype(jnp.float32), lp["post_ln_w"].astype(jnp.float32),
+        lp["post_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
+    ).astype(dt)
+    mlp_out = parallel_mlp(cfg, lp, ln2, axis_name)
+    return (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
+                              deterministic)).astype(dt)
+
+
+def transformer_block(
+    cfg: GPTConfig,
+    layer_params: Dict[str, jax.Array],  # stacked [L, ...]
+    hidden: jax.Array,
+    attention_mask: Optional[jax.Array],
+    axis_name: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Scan the stacked layers (reference ``ParallelTransformer`` loop).
+
+    ``recompute_granularity="full"`` rematerialises each layer in backward —
+    the reference's ``--recompute-granularity full`` activation
+    checkpointing (``tensor_parallel/random.py:237``).
+    """
+    L = layer_params["qkv_w"].shape[0]
+
+    def body(carry, xs):
+        h, key = carry
+        lp, layer_number = xs
+        sub = None
+        if key is not None:
+            key, sub = jax.random.split(key)
+        h = transformer_layer(
+            cfg, lp, h, attention_mask, axis_name, sub, deterministic,
+            layer_number,
+        )
+        return (h, key), None
+
+    if cfg.recompute_granularity == "full":
+        body = jax.checkpoint(body)
+
+    (hidden, _), _ = jax.lax.scan(
+        body, (hidden, dropout_key),
+        (layer_params, jnp.arange(1, L + 1)), length=L,
+    )
+    return hidden
+
+
+# --------------------------------------------------------------------------
+# GPT
+# --------------------------------------------------------------------------
+
+def gpt_embed(
+    cfg: GPTConfig,
+    params: Pytree,
+    tokens: jax.Array,  # [b, s]
+    position_ids: Optional[jax.Array] = None,
+    axis_name: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Word + position embeddings → [s, b, h] (reference ``Embedding``)."""
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+    if axis_name is not None:
+        word = vocab_parallel_embedding(
+            tokens, params["embedding"]["word"], axis_name=axis_name
+        )
+    else:
+        word = jnp.take(params["embedding"]["word"], tokens, axis=0)
+    pos = jnp.take(params["embedding"]["position"], position_ids, axis=0)
+    emb = (word + pos).astype(cfg.compute_dtype)
+    emb = jnp.transpose(emb, (1, 0, 2))  # [b,s,h] -> [s,b,h]
+    return _dropout(emb, cfg.hidden_dropout, dropout_key, deterministic)
+
+
+def gpt_forward(
+    cfg: GPTConfig,
+    params: Pytree,
+    tokens: jax.Array,  # [b, s]
+    axis_name: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Full GPT forward → vocab(-parallel) logits [b, s, v(/tp)]
+    (reference ``GPTModel.forward`` + ``post_language_model_processing``)."""
+    k_embed = k_block = None
+    if dropout_key is not None:
+        k_embed, k_block = jax.random.split(dropout_key)
+    hidden = gpt_embed(
+        cfg, params, tokens, None, axis_name, k_embed, deterministic
+    )
+    hidden = transformer_block(
+        cfg, params["layers"], hidden, None, axis_name, k_block,
+        deterministic,
+    )
+    hidden = fused_layer_norm(
+        hidden.astype(jnp.float32),
+        params["final_ln_w"].astype(jnp.float32),
+        params["final_ln_b"].astype(jnp.float32),
+        eps=cfg.layernorm_epsilon,
+    ).astype(cfg.compute_dtype)
+    logits = _lm_head(cfg, params, hidden, axis_name)
+    return jnp.transpose(logits, (1, 0, 2))  # [b, s, v(/tp)]
+
+
+def _lm_head(cfg, params, hidden, axis_name):
+    """Tied-embedding output head: a column-parallel GEMM over the
+    vocab-sharded table (reference ``parallel_lm_logits``) — the
+    copy-to-region makes backward all-reduce the partial d(hidden)."""
+    if axis_name is not None:
+        hidden = mappings.copy_to_tensor_model_parallel_region(
+            hidden, axis_name
+        )
+    return jnp.einsum(
+        "sbh,vh->sbv", hidden,
+        params["embedding"]["word"].astype(cfg.compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def gpt_loss(
+    cfg: GPTConfig,
+    params: Pytree,
+    tokens: jax.Array,  # [b, s]
+    labels: jax.Array,  # [b, s]
+    loss_mask: Optional[jax.Array] = None,
+    axis_name: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Masked mean LM loss (reference GPT ``loss_func``)."""
+    logits = gpt_forward(
+        cfg, params, tokens, axis_name, dropout_key, deterministic
+    )
+    if axis_name is not None:
+        losses = vocab_parallel_cross_entropy(logits, labels, 0.0, axis_name)
+    else:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        losses = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+    if loss_mask is None:
+        return jnp.mean(losses)
+    m = loss_mask.astype(jnp.float32)
+    return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+# --------------------------------------------------------------------------
+# BERT
+# --------------------------------------------------------------------------
+
+def bert_forward(
+    cfg: GPTConfig,
+    params: Pytree,
+    tokens: jax.Array,  # [b, s]
+    padding_mask: Optional[jax.Array] = None,  # [b, s] 1 = real token
+    axis_name: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """BERT-style bidirectional encoder (reference ``standalone_bert.py``):
+    padding-mask attention, MLM logits via the tied embedding head, optional
+    binary (NSP) head over the pooled first token."""
+    b, s = tokens.shape
+    if padding_mask is None:
+        padding_mask = jnp.ones((b, s), jnp.int32)
+    # [b, 1, sq, sk] nonzero = masked out
+    attn_mask = (padding_mask[:, None, None, :] == 0).astype(jnp.int8)
+    attn_mask = jnp.broadcast_to(attn_mask, (b, 1, s, s))
+
+    cfg_pad = dataclasses.replace(cfg, attn_mask_type=AttnMaskType.padding)
+    k_embed = k_block = None
+    if dropout_key is not None:
+        k_embed, k_block = jax.random.split(dropout_key)
+    hidden = gpt_embed(
+        cfg_pad, params, tokens, None, axis_name, k_embed, deterministic
+    )
+    hidden = transformer_block(
+        cfg_pad, params["layers"], hidden, attn_mask, axis_name, k_block,
+        deterministic,
+    )
+    hidden = fused_layer_norm(
+        hidden.astype(jnp.float32),
+        params["final_ln_w"].astype(jnp.float32),
+        params["final_ln_b"].astype(jnp.float32),
+        eps=cfg.layernorm_epsilon,
+    ).astype(cfg.compute_dtype)
+
+    lm_logits = _lm_head(cfg, params, hidden, axis_name)
+    lm_logits = jnp.transpose(lm_logits, (1, 0, 2))
+
+    binary_logits = None
+    if cfg.add_binary_head and "binary_head" in params:
+        bh = params["binary_head"]
+        pooled = jnp.tanh(
+            hidden[0] @ bh["pooler_w"].astype(hidden.dtype)
+            + bh["pooler_b"].astype(hidden.dtype)
+        )  # first token, [b, h]
+        binary_logits = (
+            pooled @ bh["head_w"].T.astype(pooled.dtype)
+            + bh["head_b"].astype(pooled.dtype)
+        )
+    return lm_logits, binary_logits

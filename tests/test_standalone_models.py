@@ -1,0 +1,234 @@
+"""Tests for the standalone GPT/BERT models.
+
+Key check (reference idiom from ``test_pipeline_parallel_fwd_bwd.py`` and
+the GPT/BERT minimal tests): the TP=8 sharded forward/loss must equal the
+dense single-device computation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import (
+    DistributedTestBase,
+    GPTConfig,
+    bert_model_provider,
+    gpt_loss,
+    gpt_model_provider,
+    gpt_partition_specs,
+    init_gpt_params,
+    set_random_seed,
+)
+
+TP = 8
+
+
+def _small_cfg(**kw):
+    defaults = dict(
+        num_layers=2,
+        hidden_size=32,
+        num_attention_heads=8,
+        vocab_size=128,
+        max_position_embeddings=32,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        tensor_model_parallel_size=1,
+    )
+    defaults.update(kw)
+    return GPTConfig(**defaults)
+
+
+def test_gpt_forward_shapes_and_loss():
+    cfg = _small_cfg()
+    key = set_random_seed(1234)
+    params, fwd, loss = gpt_model_provider(cfg, key)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 128)
+    logits = fwd(params, tokens)
+    assert logits.shape == (2, 16, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    l = loss(params, tokens, labels)
+    assert np.isfinite(float(l)) and float(l) > 0
+
+
+def test_gpt_tp_matches_dense():
+    cfg_dense = _small_cfg()
+    cfg_tp = _small_cfg(tensor_model_parallel_size=TP)
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    mesh = parallel_state.get_mesh()
+    key = jax.random.PRNGKey(7)
+    params = init_gpt_params(cfg_dense, key)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 128)
+
+    dense_loss = gpt_loss(cfg_dense, params, tokens, labels)
+    dense_grads = jax.grad(
+        lambda p: gpt_loss(cfg_dense, p, tokens, labels)
+    )(params)
+
+    specs = gpt_partition_specs(cfg_tp)
+
+    def local_loss(p, t, lab):
+        return gpt_loss(cfg_tp, p, t, lab, axis_name="tensor")
+
+    tp_loss = jax.shard_map(
+        local_loss, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P(),
+        check_vma=True,
+    )(params, tokens, labels)
+    np.testing.assert_allclose(float(tp_loss), float(dense_loss), rtol=2e-4)
+
+    # gradients of the sharded model match the dense ones (shard-for-shard)
+    tp_grads = jax.shard_map(
+        jax.grad(local_loss), mesh=mesh,
+        in_specs=(specs, P(), P()), out_specs=specs, check_vma=True,
+    )(params, tokens, labels)
+    for name in ("qkv_w", "fc2_w", "input_ln_w"):
+        np.testing.assert_allclose(
+            np.asarray(tp_grads["layers"][name]),
+            np.asarray(dense_grads["layers"][name]),
+            atol=5e-4, err_msg=name,
+        )
+    np.testing.assert_allclose(
+        np.asarray(tp_grads["embedding"]["word"]),
+        np.asarray(dense_grads["embedding"]["word"]),
+        atol=5e-4,
+    )
+    parallel_state.destroy_model_parallel()
+
+
+def test_gpt_recompute_matches_plain():
+    cfg = _small_cfg()
+    cfg_r = _small_cfg(recompute_granularity="full")
+    params = init_gpt_params(cfg, jax.random.PRNGKey(5))
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, 128)
+    l1 = gpt_loss(cfg, params, tokens, labels)
+    l2 = gpt_loss(cfg_r, params, tokens, labels)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    g1 = jax.grad(lambda p: gpt_loss(cfg, p, tokens, labels))(params)
+    g2 = jax.grad(lambda p: gpt_loss(cfg_r, p, tokens, labels))(params)
+    np.testing.assert_allclose(
+        np.asarray(g1["layers"]["qkv_w"]), np.asarray(g2["layers"]["qkv_w"]),
+        atol=1e-6,
+    )
+
+
+def test_gpt_cpu_offload_matches():
+    cfg = _small_cfg()
+    params, fwd, loss = gpt_model_provider(
+        cfg, jax.random.PRNGKey(8), cpu_offload=True
+    )
+    params2, fwd2, loss2 = gpt_model_provider(cfg, jax.random.PRNGKey(8))
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (1, 8), 0, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(10), (1, 8), 0, 128)
+    np.testing.assert_allclose(
+        float(loss(params, tokens, labels)),
+        float(loss2(params2, tokens, labels)),
+        rtol=1e-6,
+    )
+
+
+def test_gpt_dropout_determinism():
+    cfg = _small_cfg(hidden_dropout=0.1, attention_dropout=0.1)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(11))
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (2, 8), 0, 128)
+    labels = jnp.zeros_like(tokens)
+    k = jax.random.PRNGKey(13)
+    l1 = gpt_loss(cfg, params, tokens, labels, dropout_key=k, deterministic=False)
+    l2 = gpt_loss(cfg, params, tokens, labels, dropout_key=k, deterministic=False)
+    l3 = gpt_loss(
+        cfg, params, tokens, labels, dropout_key=jax.random.PRNGKey(99),
+        deterministic=False,
+    )
+    assert float(l1) == float(l2)  # same key -> identical
+    assert float(l1) != float(l3)  # different key -> different dropout
+
+
+def test_bert_forward_and_loss():
+    cfg = _small_cfg(add_binary_head=True)
+    params, fwd, loss_fn = bert_model_provider(cfg, jax.random.PRNGKey(14))
+    tokens = jax.random.randint(jax.random.PRNGKey(15), (2, 12), 0, 128)
+    padding = jnp.concatenate(
+        [jnp.ones((2, 8), jnp.int32), jnp.zeros((2, 4), jnp.int32)], axis=1
+    )
+    lm_logits, bin_logits = fwd(params, tokens, padding)
+    assert lm_logits.shape == (2, 12, 128)
+    assert bin_logits.shape == (2, 2)
+
+    labels = jax.random.randint(jax.random.PRNGKey(16), (2, 12), 0, 128)
+    loss_mask = padding
+    l = loss_fn(
+        params, tokens, labels, loss_mask,
+        padding_mask=padding, binary_labels=jnp.array([0, 1]),
+    )
+    assert np.isfinite(float(l))
+
+    # padding tokens must not influence unpadded positions' logits
+    tokens2 = tokens.at[:, 8:].set(7)  # change padded region
+    lm_logits2, _ = fwd(params, tokens2, padding)
+    np.testing.assert_allclose(
+        np.asarray(lm_logits[:, :8]), np.asarray(lm_logits2[:, :8]), atol=1e-5
+    )
+
+
+def test_distributed_test_base():
+    class MyTest(DistributedTestBase):
+        MAX_WORLD_SIZE = 4
+
+        def test_world(self):
+            assert self.world_size == 4
+            mesh = self.initialize_model_parallel(tp=2, pp=2)
+            assert parallel_state.get_tensor_model_parallel_world_size() == 2
+            assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+
+    import unittest
+
+    suite = unittest.TestLoader().loadTestsFromTestCase(MyTest)
+    result = unittest.TextTestRunner(verbosity=0).run(suite)
+    assert result.wasSuccessful()
+    assert not parallel_state.model_parallel_is_initialized()
+
+
+def test_arguments_parse_and_validate():
+    from apex_tpu.transformer.testing.arguments import parse_args
+
+    args = parse_args(args=[
+        "--num-layers", "4", "--hidden-size", "64",
+        "--num-attention-heads", "4", "--seq-length", "32",
+        "--max-position-embeddings", "32",
+        "--micro-batch-size", "2", "--global-batch-size", "16",
+        "--tensor-model-parallel-size", "2", "--bf16",
+        "--world-size", "8",
+    ])
+    assert args.data_parallel_size == 4
+    assert args.params_dtype == "bfloat16"
+    assert args.ffn_hidden_size == 256
+    assert args.kv_channels == 16
+
+    with pytest.raises(ValueError):
+        parse_args(args=["--hidden-size", "64", "--num-attention-heads", "4",
+                         "--fp16", "--bf16", "--world-size", "8"])
+    with pytest.raises(ValueError):
+        parse_args(args=[
+            "--hidden-size", "64", "--num-attention-heads", "4",
+            "--tensor-model-parallel-size", "3", "--world-size", "8",
+        ])
+
+
+def test_global_vars_lifecycle():
+    from apex_tpu.transformer.testing import global_vars as gv
+
+    gv.destroy_global_vars()
+    args = gv.set_global_variables(override_args=[
+        "--hidden-size", "64", "--num-attention-heads", "4",
+        "--micro-batch-size", "2", "--global-batch-size", "8",
+        "--world-size", "2",
+    ])
+    assert gv.get_args() is args
+    assert gv.get_num_microbatches() == 2  # 8 / (mbs 2 * dp 2)
+    timers = gv.get_timers()
+    timers("step").start()
+    timers("step").stop()
+    assert timers("step").elapsed() >= 0
+    gv.destroy_global_vars()

@@ -32,9 +32,10 @@ def _mesh():
     return parallel_state.get_mesh()
 
 
-def _smap(f, in_specs, out_specs):
+def _smap(f, in_specs, out_specs, check_vma=True):
     return shard_map(
-        f, mesh=_mesh(), in_specs=in_specs, out_specs=out_specs, check_vma=False
+        f, mesh=_mesh(), in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma,
     )
 
 
@@ -63,21 +64,27 @@ def test_parallel_state_split_rank():
 # --- mappings fwd/bwd duality (reference test_mapping.py) --------------------
 
 def test_copy_region_fwd_identity_bwd_allreduce():
-    x = jax.random.normal(jax.random.PRNGKey(0), (TP, 4))
+    x = jax.random.normal(jax.random.PRNGKey(0), (4,))
 
-    def f(xs):
-        y = tp.copy_to_tensor_model_parallel_region(xs, "tensor")
-        return y
+    def f(x_rep):
+        return tp.copy_to_tensor_model_parallel_region(x_rep, "tensor")
 
-    out = _smap(f, P("tensor", None), P("tensor", None))(x)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    # forward: identity (replicated input passes through)
+    out = _smap(f, P(), P("tensor"))(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.tile(np.asarray(x), TP) / 1.0
+    )
 
-    # bwd: grad of sum(f(x)*c) wrt x is psum(c) per shard
-    def g(xs):
-        return jnp.sum(tp.copy_to_tensor_model_parallel_region(xs, "tensor"))
+    # backward: a replicated input feeding device-varying compute gets the
+    # per-rank cotangents ALL-REDUCED (the Megatron copy-region dual, here
+    # produced by the vma transpose): each rank contributes rank+1 → psum
+    def g(x_rep):
+        rank = jax.lax.axis_index("tensor")
+        y = tp.copy_to_tensor_model_parallel_region(x_rep, "tensor")
+        return jnp.sum(y * (rank + 1.0))
 
-    grads = _smap(jax.grad(g), P("tensor", None), P("tensor", None))(x)
-    np.testing.assert_allclose(np.asarray(grads), TP * 1.0)
+    grads = _smap(jax.grad(g), P(), P())(x)
+    np.testing.assert_allclose(np.asarray(grads), sum(range(1, TP + 1)) * 1.0)
 
 
 def test_reduce_region_fwd_allreduce():
@@ -97,7 +104,7 @@ def test_scatter_gather_roundtrip():
         assert local.shape == (4, 5)
         return tp.gather_from_tensor_model_parallel_region(local, "tensor")
 
-    out = _smap(f, P(), P())(full)
+    out = _smap(f, P(), P(), check_vma=False)(full)
     np.testing.assert_allclose(np.asarray(out), np.asarray(full))
 
 
@@ -110,7 +117,7 @@ def test_sequence_parallel_roundtrip_and_reduce_scatter():
         assert local.shape == (3, 2, 4)
         return tp.gather_from_sequence_parallel_region(local, "tensor", True)
 
-    out = _smap(f, P(), P())(full)
+    out = _smap(f, P(), P(), check_vma=False)(full)
     np.testing.assert_allclose(np.asarray(out), np.asarray(full))
 
     # reduce_scatter: each shard ends with the summed slice
@@ -137,7 +144,7 @@ def test_column_parallel_linear_matches_dense():
         return out
 
     out = _smap(
-        f, (P(), P("tensor", None), P("tensor")), P()
+        f, (P(), P("tensor", None), P("tensor")), P(), check_vma=False
     )(x, w_full, b_full)
     ref = x @ w_full.T + b_full
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
@@ -180,9 +187,7 @@ def test_column_row_pair_backward_matches_dense():
         y, _ = tp.row_parallel_linear(
             h, w2_s, None, axis_name="tensor", input_is_parallel=True
         )
-        return jnp.sum(y**2) / TP  # replicated loss summed by psum in grads? no:
-        # loss is identical on every shard; grad wrt replicated x arrives
-        # synced through the copy-region backward allreduce.
+        return jnp.sum(y**2)
 
     grads_tp = _smap(
         jax.grad(tp_loss, argnums=(0, 1, 2)),
@@ -194,9 +199,9 @@ def test_column_row_pair_backward_matches_dense():
     gx, gw1, gw2 = [
         np.asarray(g) for g in jax.grad(dense_loss, argnums=(0, 1, 2))(x, w1, w2)
     ]
-    np.testing.assert_allclose(gx_tp * TP, gx, atol=2e-4)
-    np.testing.assert_allclose(gw1_tp * TP, gw1, atol=2e-4)
-    np.testing.assert_allclose(gw2_tp * TP, gw2, atol=2e-4)
+    np.testing.assert_allclose(gx_tp, gx, atol=2e-4)
+    np.testing.assert_allclose(gw1_tp, gw1, atol=2e-4)
+    np.testing.assert_allclose(gw2_tp, gw2, atol=2e-4)
 
 
 def test_vocab_parallel_embedding_matches_dense():
