@@ -89,9 +89,18 @@ def _ln_bwd_kernel(dy_ref, x_ref, mu_ref, rstd_ref, w_ref, dx_ref, *out_refs, af
     dx = (wdy - xhat * c1 - c2) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
     if affine:
+        # dw/db accumulate into one (1, hidden) block revisited by every
+        # grid step (TPU grid is sequential) — per-block partial outputs
+        # would need block rows divisible by 8
         dw_ref, db_ref = out_refs
-        dw_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-        db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            dw_ref[:] = jnp.zeros_like(dw_ref)
+            db_ref[:] = jnp.zeros_like(db_ref)
+
+        dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+        db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps, affine):
@@ -114,15 +123,18 @@ def _rms_bwd_kernel(dy_ref, x_ref, rstd_ref, w_ref, dx_ref, *out_refs, affine, x
     dx = (wdy - xhat * c1) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
     if affine:
-        out_refs[0][:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_refs[0][:] = jnp.zeros_like(out_refs[0])
+
+        out_refs[0][:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
 
 
 def _row_specs(br: int, hidden: int):
     row = pl.BlockSpec((br, hidden), lambda i: (i, 0))
     stat = pl.BlockSpec((br, 1), lambda i: (i, 0))
     vec = pl.BlockSpec((1, hidden), lambda i: (0, 0))
-    partial = pl.BlockSpec((1, hidden), lambda i: (i, 0))
-    return row, stat, vec, partial
+    return row, stat, vec, vec
 
 
 def _ln_fwd_pallas(x2d, w, b, eps, affine, interpret):
@@ -156,8 +168,8 @@ def _ln_bwd_pallas(dy2d, x2d, mu, rstd, w, affine, x_is_xhat, interpret):
     out_specs = [row] + ([partial, partial] if affine else [])
     out_shape = [jax.ShapeDtypeStruct((rows, hidden), dy2d.dtype)] + (
         [
-            jax.ShapeDtypeStruct((nblocks, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
         ]
         if affine
         else []
@@ -172,7 +184,7 @@ def _ln_bwd_pallas(dy2d, x2d, mu, rstd, w, affine, x_is_xhat, interpret):
     )(dy2d, x2d, mu, rstd, w2)
     if affine:
         dx, dw_p, db_p = outs
-        return dx, jnp.sum(dw_p, axis=0), jnp.sum(db_p, axis=0)
+        return dx, dw_p[0], db_p[0]
     return outs[0], None, None
 
 
@@ -203,7 +215,7 @@ def _rms_bwd_pallas(dy2d, x2d, rstd, w, affine, x_is_xhat, interpret):
     w2 = (w if affine else jnp.ones((hidden,), jnp.float32)).reshape(1, hidden)
     out_specs = [row] + ([partial] if affine else [])
     out_shape = [jax.ShapeDtypeStruct((rows, hidden), dy2d.dtype)] + (
-        [jax.ShapeDtypeStruct((nblocks, hidden), jnp.float32)] if affine else []
+        [jax.ShapeDtypeStruct((1, hidden), jnp.float32)] if affine else []
     )
     outs = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, affine=affine, x_is_xhat=x_is_xhat),
@@ -214,7 +226,7 @@ def _rms_bwd_pallas(dy2d, x2d, rstd, w, affine, x_is_xhat, interpret):
         interpret=interpret,
     )(dy2d, x2d, rstd, w2)
     if affine:
-        return outs[0], jnp.sum(outs[1], axis=0)
+        return outs[0], outs[1][0]
     return outs[0], None
 
 

@@ -9,7 +9,7 @@ microbatches across chunks to shrink the pipeline bubble from
 
 TPU-native: the dataflow — every microbatch traverses the stage ring ``vpp``
 times — is expressed as ``vpp`` pipeline rounds with a last→first ppermute
-hand-off between rounds (``_pipeline_rounds`` in the non-interleaved
+hand-off between rounds (``pipeline_rounds`` in the non-interleaved
 module). The *numerics* are identical to the reference's interleaved
 schedule (same chunk composition order); the *overlap* of rounds — the
 bubble-shrinking part — is left to XLA's scheduler over the single traced

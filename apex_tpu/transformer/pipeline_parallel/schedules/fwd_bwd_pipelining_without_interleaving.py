@@ -35,7 +35,7 @@ from ... import parallel_state
 Pytree = Any
 
 
-def _pipeline_rounds(
+def pipeline_rounds(
     stage_fn: Callable,
     stage_params_chunks,  # tuple of per-chunk local params (vpp entries)
     inputs: jax.Array,  # [n, ...] microbatched first-stage activations
@@ -67,9 +67,12 @@ def _pipeline_rounds(
             # the last stage's y at tick t is microbatch t-(pp-1)
             return new_state, y
 
-        _, ys = jax.lax.scan(
-            body, jnp.zeros_like(inputs[0]), jnp.arange(n + pp - 1)
-        )
+        init = jnp.zeros_like(inputs[0])
+        # the carry is pipeline-varying (it came through a ppermute); mark
+        # the zeros init accordingly for shard_map's vma tracking
+        if hasattr(jax.lax, "pvary") and axis_name not in init.aval.vma:
+            init = jax.lax.pvary(init, (axis_name,))
+        _, ys = jax.lax.scan(body, init, jnp.arange(n + pp - 1))
         return ys[pp - 1 :]  # [n, ...] microbatch-ordered, valid on last stage
 
     outs = inputs
@@ -133,7 +136,7 @@ def pipeline_forward_backward(
         )
 
     def local_loss(params, inputs):
-        outs = _pipeline_rounds(
+        outs = pipeline_rounds(
             stage_fn, chunks_of(params), inputs, a, checkpoint_stages
         )
 

@@ -1,0 +1,93 @@
+"""Benchmark: GPT-2 345M train step on one TPU chip, bf16 + FusedAdam.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md: "published": {}), so
+``vs_baseline`` is reported against a stored previous-round value in
+``BENCH_BASELINE.json`` when present (ratio >1 = faster than before), else
+null. Config mirrors BASELINE.md config #4's model (GPT-2 345M: 24 layers,
+hidden 1024, 16 heads, seq 1024) on a single chip.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import GPTConfig, gpt_loss, init_gpt_params
+
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    cfg = GPTConfig(
+        num_layers=24,
+        hidden_size=1024,
+        num_attention_heads=16,
+        vocab_size=50304,
+        max_position_embeddings=seq,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16,
+        recompute_granularity=os.environ.get("BENCH_RECOMPUTE") or None,
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss(cfg, p, tokens, labels)
+        )(params)
+        params, opt_state = opt.step(grads, opt_state, params)
+        return params, opt_state, loss
+
+    # warmup (compile)
+    for _ in range(2):
+        params, opt_state, loss = train_step(params, opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = train_step(params, opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    step_ms = dt / iters * 1000.0
+
+    vs_baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")) as f:
+            base = json.load(f)
+        if base.get("unit") == "tokens/sec" and base.get("value"):
+            vs_baseline = tokens_per_sec / float(base["value"])
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_345m_1chip_bf16_train_throughput",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(vs_baseline, 4) if vs_baseline else None,
+                "step_ms": round(step_ms, 2),
+                "batch": batch,
+                "seq": seq,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
