@@ -17,3 +17,8 @@ from .multi_tensor import (  # noqa: F401
     l2norm,
     has_inf_or_nan,
 )
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_sbhd,
+    flash_attention_available,
+)

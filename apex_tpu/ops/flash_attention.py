@@ -1,0 +1,526 @@
+"""Flash attention — tiled online-softmax Pallas TPU kernels, fwd + bwd.
+
+TPU-native replacement for the reference's two fused-attention generations:
+``apex/contrib/csrc/fmha/`` (~6k LoC CUDA, seq<=512, fp16, varlen) and
+``apex/contrib/csrc/multihead_attn/`` (~9k LoC incl. ``softmax.cuh``).
+Python consumers in the reference: ``apex/contrib/fmha/fmha.py:33-92`` and
+``apex/contrib/multihead_attn/``.
+
+Instead of the CUDA kernels' per-seqlen template instantiations, one tiled
+kernel handles any sequence length: attention is computed in
+``[block_q, block_k]`` score tiles with the online-softmax recurrence
+(running row max ``m``, normalizer ``l``, rescaled accumulator), so the
+full ``[b, n, s, s]`` score tensor is never materialised — O(s) memory per
+row block instead of O(s^2) per head. Backward recomputes score tiles from
+the saved logsumexp (the flash-attention-2 scheme): one kernel accumulates
+dq over key blocks, a second accumulates dk/dv over query blocks, with
+``delta = rowsum(dO * O)`` precomputed in XLA.
+
+Layouts: ``[b, n, s, d]`` (canonical) via :func:`flash_attention`, and the
+Megatron ``[s, b, n, d]`` convenience wrapper :func:`flash_attention_sbhd`
+used by ``transformer/testing/standalone_transformer_lm.py``.
+
+Supports: causal masking (block-skipped: tiles strictly above the diagonal
+are neither loaded nor computed), a key-padding mask ``[b, s_k]`` (True =
+attend), softmax scale. Dropout is applied by callers outside the kernel
+(the XLA path); kernel-internal Philox dropout as in the reference fmha is
+not implemented.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; CPU-only envs use interpret mode or the XLA path
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _pick_block(s: int, want: int) -> int:
+    for cand in (want, 512, 256, 128, 64, 32, 16, 8):
+        if cand <= want and s % cand == 0:
+            return cand
+    return s
+
+
+def flash_attention_available(
+    s_q: int, s_k: int, d: int, interpret: bool = False
+) -> bool:
+    """Availability heuristic (the analogue of the reference fmha's
+    fp16/seq<=512 gate, ``contrib/fmha/fmha.py`` + ``fused_softmax.py``
+    ``is_kernel_available``)."""
+    if os.environ.get("APEX_TPU_DISABLE_FLASH"):
+        return False
+    if interpret:
+        return True
+    if pltpu is None or jax.default_backend() != "tpu":
+        return False
+    # need tileable seq blocks and a head dim the MXU can use
+    return s_q % 8 == 0 and s_k % 8 == 0 and d <= 256
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k, n_k, have_mask,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+
+        if causal:
+            qi = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            ki = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(ki > qi, _NEG_INF, s)
+        if have_mask:
+            keep = mask_ref[0] != 0  # [1, bk]
+            s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows: exp(-inf - -inf) -> use 0 contribution
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip tiles strictly above the diagonal
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        m = m_scr[:, :1]
+        lse_ref[0, 0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(safe_l))
+
+
+def _fwd(
+    q, k, v, kv_mask, scale, causal, block_q, block_k, interpret
+):
+    b, n, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq = _pick_block(s_q, block_q)
+    bk = _pick_block(s_k, block_k)
+    n_q, n_k = s_q // bq, s_k // bk
+
+    have_mask = kv_mask is not None
+    mask_arg = (
+        kv_mask.astype(jnp.int8).reshape(b, 1, s_k)
+        if have_mask
+        else jnp.zeros((b, 1, 8), jnp.int8)
+    )
+    mask_spec = pl.BlockSpec(
+        (1, 1, bk if have_mask else 8),
+        (lambda ib, ih, iq, ik: (ib, 0, ik if have_mask else 0)),
+    )
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
+        have_mask=have_mask,
+    )
+    grid = (b, n, n_q, n_k)
+    out_shape = [
+        jax.ShapeDtypeStruct((b, n, s_q, d), q.dtype),
+        jax.ShapeDtypeStruct((b, n, s_q, 1), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, d), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            mask_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
+            ),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, mask_arg)
+    return o, lse[..., 0]  # lse [b, n, s_q]
+
+
+def _compiler_params():
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dq_ref,
+    acc_scr,
+    *, scale, causal, block_q, block_k, n_k, have_mask,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qi = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            ki = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(ki > qi, _NEG_INF, s)
+        if have_mask:
+            keep = mask_ref[0] != 0
+            s = jnp.where(keep, s, _NEG_INF)
+        lse = lse_ref[0, 0][:, :1]  # [bq, 1]
+        p = jnp.exp(s - lse)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0][:, :1]
+        ds = p * (dp - delta)
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale, causal, block_q, block_k, n_q, have_mask,
+):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            qi = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            ki = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(ki > qi, _NEG_INF, s)
+        if have_mask:
+            keep = mask_ref[0] != 0
+            s = jnp.where(keep, s, _NEG_INF)
+        lse = lse_ref[0, 0][:, :1]
+        p = jnp.exp(s - lse)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        do = do_ref[0, 0].astype(jnp.float32)
+        # dv += p.T @ do
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = delta_ref[0, 0][:, :1]
+        ds = p * (dp - delta)  # [bq, bk]
+        # dk += ds.T @ q * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(
+    q, k, v, kv_mask, o, lse, do, scale, causal, block_q, block_k, interpret
+):
+    b, n, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq = _pick_block(s_q, block_q)
+    bk = _pick_block(s_k, block_k)
+    n_q, n_k = s_q // bq, s_k // bk
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # [b, n, s_q]
+    # row stats as lane-dim-1 buffers (tiny DMA per block; the same layout
+    # trick as ops/layer_norm.py's per-row stat blocks)
+    lse_b = lse[..., None]
+    delta_b = delta[..., None]
+
+    have_mask = kv_mask is not None
+    mask_arg = (
+        kv_mask.astype(jnp.int8).reshape(b, 1, s_k)
+        if have_mask
+        else jnp.zeros((b, 1, 8), jnp.int8)
+    )
+
+    def mask_spec(kmajor):
+        if have_mask:
+            if kmajor:
+                return pl.BlockSpec((1, 1, bk), lambda ib, ih, ik, iq: (ib, 0, ik))
+            return pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, ik: (ib, 0, ik))
+        return pl.BlockSpec((1, 1, 8), lambda ib, ih, i2, i3: (ib, 0, 0))
+
+    q_spec = lambda im: pl.BlockSpec((1, 1, bq, d), im)
+    k_spec = lambda im: pl.BlockSpec((1, 1, bk, d), im)
+    row_spec = lambda im: pl.BlockSpec((1, 1, bq, 1), im)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
+            have_mask=have_mask,
+        ),
+        grid=(b, n, n_q, n_k),
+        in_specs=[
+            q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            k_spec(lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            k_spec(lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            mask_spec(False),
+        ],
+        out_specs=q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, mask_arg)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            scale=scale, causal=causal, block_q=bq, block_k=bk, n_q=n_q,
+            have_mask=have_mask,
+        ),
+        grid=(b, n, n_k, n_q),
+        in_specs=[
+            q_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+            k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+            q_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            mask_spec(True),
+        ],
+        out_specs=[
+            k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+            k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, mask_arg)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+)
+def _flash(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(
+        q, k, v, kv_mask, scale, causal, block_q, block_k, interpret
+    )
+    return o, (q, k, v, kv_mask, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, kv_mask, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, kv_mask, o, lse, do, scale, causal, block_q, block_k,
+        interpret,
+    )
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [b, n, s_q, d]
+    k: jax.Array,  # [b, n, s_k, d]
+    v: jax.Array,  # [b, n, s_k, d]
+    *,
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,  # [b, s_k]; True/nonzero = attend
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tiled online-softmax attention, O(s) memory per row block.
+
+    Returns ``softmax(q @ k.T * scale [masked]) @ v`` in ``q.dtype``
+    without materialising the score tensor. Differentiable (custom VJP
+    recomputes score tiles from the saved logsumexp).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.int8)
+    # off-TPU the kernel runs in the Pallas interpreter (tests exercise the
+    # same code path the TPU compiles)
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True
+    return _flash(
+        q, k, v, kv_mask, float(scale), bool(causal),
+        int(block_q), int(block_k), bool(interpret),
+    )
+
+
+def flash_attention_sbhd(
+    q: jax.Array,  # [s, b, n, d]
+    k: jax.Array,
+    v: jax.Array,
+    **kw,
+) -> jax.Array:
+    """Megatron ``[s, b, n, d]`` layout wrapper → context [s, b, n, d]."""
+    qt = jnp.transpose(q, (1, 2, 0, 3))
+    kt = jnp.transpose(k, (1, 2, 0, 3))
+    vt = jnp.transpose(v, (1, 2, 0, 3))
+    o = flash_attention(qt, kt, vt, **kw)
+    return jnp.transpose(o, (2, 0, 1, 3))
+
+
+def mha_reference(
+    q, k, v, *, causal=False, kv_mask=None, scale=None
+) -> jax.Array:
+    """Materialised-score reference (for tests): same math, O(s^2)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(ki > qi, _NEG_INF, s)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] != 0, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bnqk,bnkd->bnqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)

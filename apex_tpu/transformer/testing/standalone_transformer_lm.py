@@ -41,6 +41,10 @@ from ..tensor_parallel import (
 )
 from ..tensor_parallel import mappings
 from ...ops.layer_norm import layer_norm as fused_layer_norm
+from ...ops.flash_attention import (
+    flash_attention_available,
+    flash_attention_sbhd,
+)
 
 Pytree = Any
 
@@ -66,6 +70,9 @@ class GPTConfig:
     apply_query_key_layer_scaling: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     recompute_granularity: Optional[str] = None  # None | "full"
+    # None = auto (Pallas flash attention when available & applicable);
+    # True forces it (errors if inapplicable); False forces the XLA path.
+    use_flash_attention: Optional[bool] = None
     # BERT extras
     add_binary_head: bool = False
 
@@ -197,6 +204,9 @@ def parallel_attention(
         qkv = (jnp.einsum("sbh,oh->sbo", hidden, lp["qkv_w"].astype(hidden.dtype))
                + lp["qkv_b"].astype(hidden.dtype))
 
+    # under sequence parallelism the column-parallel QKV gathered the
+    # scattered [s/tp] input back to the full sequence length
+    s = qkv.shape[0]
     qkv = qkv.reshape(s, b, np_local, 3 * hn)
     q, kk, vv = jnp.split(qkv, 3, axis=-1)  # [s, b, np, hn]
 
@@ -208,46 +218,96 @@ def parallel_attention(
         and cfg.compute_dtype == jnp.float16
         and layer_number is not None
     )
-    norm_factor = hn ** 0.5
-    coeff = None
-    if qk_scaling:
-        coeff = jnp.maximum(layer_number.astype(jnp.float32), 1.0)
-        norm_factor = norm_factor * coeff
-    scores = jnp.einsum(
-        "sbnh,tbnh->bnst", q, kk, preferred_element_type=jnp.float32
-    ) / norm_factor
 
-    if coeff is not None:
-        # traced scale: inline fp32 softmax (the Pallas kernel needs a
-        # static scale; fp16+layer-scaling takes the XLA path)
-        x = scores * coeff
-        if cfg.attn_mask_type == AttnMaskType.causal:
-            qi = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 0)
-            ki = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 1)
-            x = jnp.where(ki > qi, -10000.0, x)
-        elif attention_mask is not None:
-            x = jnp.where(attention_mask != 0, -10000.0, x)
-        probs = jax.nn.softmax(x, axis=-1).astype(cfg.compute_dtype)
+    # --- flash attention path (Pallas, O(s) memory) ---------------------
+    # Replaces the materialised-[b,np,sq,sk] scores below when applicable:
+    # no traced per-layer scaling, no attention dropout this call, and a
+    # mask expressible as causal or key-padding ([b,1,1,sk]-broadcast).
+    # In causal mode any provided mask is ignored on every path — parity
+    # with the reference's upper-triangular kernel, which takes no mask.
+    causal = cfg.attn_mask_type == AttnMaskType.causal
+    kv_mask = None
+    mask_ok = causal
+    if (
+        not causal
+        and attention_mask is not None
+        and attention_mask.ndim == 4
+        and attention_mask.shape[1] == 1
+        and attention_mask.shape[2] == 1
+    ):
+        kv_mask = attention_mask[:, 0, 0, :] == 0  # True = attend
+        mask_ok = True
+    flash_compatible = (
+        not qk_scaling
+        and (deterministic or cfg.attention_dropout == 0.0
+             or dropout_key is None)
+        and mask_ok
+    )
+    if cfg.use_flash_attention is None:
+        use_flash = flash_compatible and flash_attention_available(s, s, hn)
+    elif cfg.use_flash_attention:
+        if not flash_compatible:
+            raise ValueError(
+                "use_flash_attention=True but the configuration is not "
+                "flash-compatible (traced qk scaling, attention dropout, "
+                "or a non-causal/non-padding mask)"
+            )
+        use_flash = True
     else:
-        softmax = FusedScaleMaskSoftmax(
-            input_in_fp16=(cfg.compute_dtype == jnp.float16),
-            input_in_bf16=(cfg.compute_dtype == jnp.bfloat16),
-            attn_mask_type=cfg.attn_mask_type,
-            mask_func=None,
-            softmax_in_fp32=True,
-            scale=None,
-        )
-        probs = softmax(scores.astype(cfg.compute_dtype), attention_mask)
+        use_flash = False
 
-    if dropout_key is not None:
-        dropout_key, sub = jax.random.split(dropout_key)
-        probs = _dropout(probs, cfg.attention_dropout, sub, deterministic)
+    if use_flash:
+        ctx = flash_attention_sbhd(
+            q, kk, vv,
+            causal=causal,
+            kv_mask=kv_mask,
+            scale=1.0 / (hn ** 0.5),
+        ).astype(hidden.dtype)
+        ctx = ctx.reshape(s, b, np_local * hn)
+    else:
+        norm_factor = hn ** 0.5
+        coeff = None
+        if qk_scaling:
+            coeff = jnp.maximum(layer_number.astype(jnp.float32), 1.0)
+            norm_factor = norm_factor * coeff
+        scores = jnp.einsum(
+            "sbnh,tbnh->bnst", q, kk, preferred_element_type=jnp.float32
+        ) / norm_factor
 
-    ctx = jnp.einsum(
-        "bnst,tbnh->sbnh", probs.astype(vv.dtype), vv,
-        preferred_element_type=jnp.float32,
-    ).astype(hidden.dtype)
-    ctx = ctx.reshape(s, b, np_local * hn)
+        if coeff is not None:
+            # traced scale: inline fp32 softmax (the Pallas kernel needs a
+            # static scale; fp16+layer-scaling takes the XLA path)
+            x = scores * coeff
+            if causal:
+                qi = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 0)
+                ki = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 1)
+                x = jnp.where(ki > qi, -10000.0, x)
+            elif attention_mask is not None:
+                x = jnp.where(attention_mask != 0, -10000.0, x)
+            probs = jax.nn.softmax(x, axis=-1).astype(cfg.compute_dtype)
+        else:
+            softmax = FusedScaleMaskSoftmax(
+                input_in_fp16=(cfg.compute_dtype == jnp.float16),
+                input_in_bf16=(cfg.compute_dtype == jnp.bfloat16),
+                attn_mask_type=cfg.attn_mask_type,
+                mask_func=None,
+                softmax_in_fp32=True,
+                scale=None,
+            )
+            probs = softmax(
+                scores.astype(cfg.compute_dtype),
+                None if causal else attention_mask,
+            )
+
+        if dropout_key is not None:
+            dropout_key, sub = jax.random.split(dropout_key)
+            probs = _dropout(probs, cfg.attention_dropout, sub, deterministic)
+
+        ctx = jnp.einsum(
+            "bnst,tbnh->sbnh", probs.astype(vv.dtype), vv,
+            preferred_element_type=jnp.float32,
+        ).astype(hidden.dtype)
+        ctx = ctx.reshape(s, b, np_local * hn)
 
     if axis_name is not None:
         out, _ = row_parallel_linear(
@@ -486,9 +546,10 @@ def bert_forward(
     b, s = tokens.shape
     if padding_mask is None:
         padding_mask = jnp.ones((b, s), jnp.int32)
-    # [b, 1, sq, sk] nonzero = masked out
+    # [b, 1, 1, sk] nonzero = masked out — kept in key-padding form so the
+    # flash path can consume it directly; the XLA/Pallas softmax paths
+    # broadcast it over sq
     attn_mask = (padding_mask[:, None, None, :] == 0).astype(jnp.int8)
-    attn_mask = jnp.broadcast_to(attn_mask, (b, 1, s, s))
 
     cfg_pad = dataclasses.replace(cfg, attn_mask_type=AttnMaskType.padding)
     k_embed = k_block = None
