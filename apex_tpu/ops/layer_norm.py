@@ -336,6 +336,28 @@ def _layer_norm_fwd(x, weight, bias, normalized_ndim, eps, memory_efficient, int
     return y, res
 
 
+def _psum_partial_param_grad(grad, cotangent, param):
+    """psum ``grad`` over mesh axes the cotangent varies on but the param
+    does not (shard_map vma bookkeeping). A replicated param consumed by
+    device-varying activations — e.g. LN weights under Megatron sequence
+    parallelism, where each TP rank normalises its s/tp sequence slice —
+    yields per-device *partial* dgamma/dbeta from the kernel. The reference
+    handles this with an explicit TP all-reduce of params tagged
+    ``sequence_parallel_enabled`` (``apex/transformer/layers/layer_norm.py``
+    + Megatron's allreduce_sequence_parallel_gradients); here the custom
+    VJP repairs its own vma so plain autodiff composes.
+    """
+    if grad is None or param is None:
+        return grad
+    try:
+        c_vma = cotangent.aval.vma
+        p_vma = param.aval.vma
+    except AttributeError:  # outside shard_map
+        return grad
+    missing = tuple(a for a in c_vma if a not in p_vma)
+    return jax.lax.psum(grad, missing) if missing else grad
+
+
 def _clamp_by_magnitude(w, floor):
     """Clamp |w| away from zero, preserving sign (reference
     ``layer_norm_cuda_kernel.cu`` ``clamp_by_magnitude`` guard for the
@@ -380,6 +402,8 @@ def _layer_norm_bwd(normalized_ndim, eps, memory_efficient, interpret, res, dy):
         if (affine and bias is not None)
         else None
     )
+    dweight = _psum_partial_param_grad(dweight, dy, weight)
+    dbias = _psum_partial_param_grad(dbias, dy, bias)
     return dx, dweight, dbias
 
 
@@ -445,6 +469,7 @@ def _rms_norm_bwd(normalized_ndim, eps, memory_efficient, interpret, res, dy):
         dx2d, dw = _rms_bwd_xla(dy2d, x2d, rstd, wf, affine, x_is_xhat)
     dx = dx2d.reshape(xshape)
     dweight = dw.reshape(weight.shape).astype(weight.dtype) if affine else None
+    dweight = _psum_partial_param_grad(dweight, dy, weight)
     return dx, dweight
 
 

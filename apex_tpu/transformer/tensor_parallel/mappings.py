@@ -34,6 +34,7 @@ over-counting by the axis size — don't differentiate TP code in that mode).
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -99,19 +100,41 @@ def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
     return _split_along_dim(x, _axis(axis_name), 0)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _gather_seq_split_backward(x, axis_name):
+    return _all_gather_dim(x, axis_name, 0)
+
+
+def _gssb_fwd(x, axis_name):
+    return _all_gather_dim(x, axis_name, 0), None
+
+
+def _gssb_bwd(axis_name, _, g):
+    return (_split_along_dim(g, axis_name, 0),)
+
+
+_gather_seq_split_backward.defvjp(_gssb_fwd, _gssb_bwd)
+
+
 def gather_from_sequence_parallel_region(
     x, axis_name: Optional[str] = None, to_model_parallel: bool = True
 ):
-    """All-gather along sequence dim; backward reduce-scatters (the SP
-    linear-layer pairing, reference ``mappings.py:231-250``) — which is
-    ``all_gather``'s JAX transpose. ``to_model_parallel`` selects the
-    embedding-path variant in the reference whose backward is a plain
-    split; that distinction is vma bookkeeping here (both transposes are
-    psum_scatter; for a cotangent that is identical across ranks the
-    reduce-scatter of 1/world-scaled contributions equals the split), so
-    the flag is accepted for parity."""
-    del to_model_parallel
-    return _all_gather_dim(x, _axis(axis_name), 0)
+    """All-gather along sequence dim (reference ``mappings.py:231-250``).
+
+    ``to_model_parallel=True`` (the SP linear-layer pairing): backward
+    reduce-scatters the per-rank partial cotangents — ``all_gather``'s JAX
+    transpose, so plain autodiff is correct.
+
+    ``to_model_parallel=False`` (the reference's embedding-path variant):
+    backward takes this rank's *slice* of the cotangent instead of
+    reduce-scattering. That is only equivalent when the consumer's
+    cotangent is identical on every rank (a replicated computation after
+    the gather); the reference encodes the caller's promise with this
+    flag, and we spell it as an explicit custom-vjp split
+    (``tests/test_tensor_parallel.py`` pins both backward behaviours)."""
+    if to_model_parallel:
+        return _all_gather_dim(x, _axis(axis_name), 0)
+    return _gather_seq_split_backward(x, _axis(axis_name))
 
 
 def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):

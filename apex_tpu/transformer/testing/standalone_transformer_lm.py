@@ -454,6 +454,12 @@ def gpt_embed(
     pos = jnp.take(params["embedding"]["position"], position_ids, axis=0)
     emb = (word + pos).astype(cfg.compute_dtype)
     emb = jnp.transpose(emb, (1, 0, 2))  # [b,s,h] -> [s,b,h]
+    if axis_name is not None and cfg.sequence_parallel:
+        # enter the sequence-parallel region: each TP rank keeps its s/tp
+        # slice (reference Megatron embedding path,
+        # ``tensor_parallel/layers.py`` SP wiring + ``mappings.py:213``);
+        # dropout below then acts on the local slice
+        emb = mappings.scatter_to_sequence_parallel_region(emb, axis_name)
     return _dropout(emb, cfg.hidden_dropout, dropout_key, deterministic)
 
 
@@ -469,6 +475,13 @@ def gpt_forward(
     (reference ``GPTModel.forward`` + ``post_language_model_processing``)."""
     k_embed = k_block = None
     if dropout_key is not None:
+        if axis_name is not None and cfg.sequence_parallel:
+            # per-rank RNG fork for dropout on sequence-scattered
+            # activations (the reference's model-parallel RNG tracker
+            # fork, ``tensor_parallel/random.py`` seed+2718+tp_rank)
+            dropout_key = jax.random.fold_in(
+                dropout_key, jax.lax.axis_index(axis_name)
+            )
         k_embed, k_block = jax.random.split(dropout_key)
     hidden = gpt_embed(
         cfg, params, tokens, None, axis_name, k_embed, deterministic
@@ -483,6 +496,13 @@ def gpt_forward(
         params["final_ln_b"].astype(jnp.float32),
         eps=cfg.layernorm_epsilon,
     ).astype(cfg.compute_dtype)
+    if axis_name is not None and cfg.sequence_parallel:
+        # leave the SP region before the LM head: all-gather the sequence
+        # (backward reduce-scatters the partial d(hidden) — the SP linear
+        # pairing, reference ``layers.py:311-437``)
+        hidden = mappings.gather_from_sequence_parallel_region(
+            hidden, axis_name
+        )
     logits = _lm_head(cfg, params, hidden, axis_name)
     return jnp.transpose(logits, (1, 0, 2))  # [b, s, v(/tp)]
 
@@ -554,6 +574,10 @@ def bert_forward(
     cfg_pad = dataclasses.replace(cfg, attn_mask_type=AttnMaskType.padding)
     k_embed = k_block = None
     if dropout_key is not None:
+        if axis_name is not None and cfg.sequence_parallel:
+            dropout_key = jax.random.fold_in(
+                dropout_key, jax.lax.axis_index(axis_name)
+            )
         k_embed, k_block = jax.random.split(dropout_key)
     hidden = gpt_embed(
         cfg_pad, params, tokens, None, axis_name, k_embed, deterministic
@@ -568,6 +592,10 @@ def bert_forward(
         params["final_ln_b"].astype(jnp.float32),
         eps=cfg.layernorm_epsilon,
     ).astype(cfg.compute_dtype)
+    if axis_name is not None and cfg.sequence_parallel:
+        hidden = mappings.gather_from_sequence_parallel_region(
+            hidden, axis_name
+        )
 
     lm_logits = _lm_head(cfg, params, hidden, axis_name)
     lm_logits = jnp.transpose(lm_logits, (1, 0, 2))

@@ -1,29 +1,71 @@
 """Benchmark: GPT-2 345M train step on one TPU chip, bf16 + FusedAdam.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-The reference publishes no numbers (BASELINE.md: "published": {}), so
-``vs_baseline`` is reported against a stored previous-round value in
-``BENCH_BASELINE.json`` when present (ratio >1 = faster than before), else
-null. Config mirrors BASELINE.md config #4's model (GPT-2 345M: 24 layers,
-hidden 1024, 16 heads, seq 1024) on a single chip.
+Measurement discipline (round-2 fixes):
+
+- params/opt_state are donated into the jitted step, so each step updates
+  in place instead of doubling the optimizer footprint;
+- steps are *chained* (step i+1 consumes step i's params) and the FINAL
+  loss value is read to the host inside the timed region — on this
+  backend ``block_until_ready`` returns before execution finishes, so a
+  device->host read is the only true synchronisation, and it also
+  surfaces any deferred error (the round-1 number timed the dispatch of a
+  program that OOM'd asynchronously);
+- ``final_loss`` is included in the JSON (must be finite);
+- implied TFLOP/s and MFU vs the chip's nominal bf16 peak are reported,
+  with a hard failure if the implied rate exceeds the peak (physically
+  impossible => measurement bug).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md
+"published": {}), so this is the ratio against the previous honest round
+stored in ``BENCH_BASELINE.json`` (>1 = faster), else null.
+
+Config mirrors BASELINE.md config #4's model (GPT-2 345M: 24 layers,
+hidden 1024, 16 heads, seq 1024) on a single chip, flash attention on.
 """
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 
 import jax
 import jax.numpy as jnp
 
+# nominal bf16 peak of the chip family (TPU v5e). Used only for the
+# physical-plausibility gate and the MFU report.
+PEAK_TFLOPS = {"tpu": 197.0, "cpu": 10.0}
+
+
+def train_flops_per_step(L, h, ffn, V, b, s, causal=True, remat=False):
+    """Dense+attention matmul FLOPs for one fwd+bwd train step."""
+    attn_pairs = s * s * (0.5 if causal else 1.0)
+    per_layer = (
+        2 * b * s * h * (3 * h)      # qkv proj
+        + 2 * 2 * b * attn_pairs * h  # qk^T and pv
+        + 2 * b * s * h * h           # out proj
+        + 2 * 2 * b * s * h * ffn     # fc1 + fc2
+    )
+    head = 2 * b * s * h * V
+    fwd = L * per_layer + head
+    total = 3 * fwd                   # bwd = 2x fwd
+    if remat:
+        # jax.checkpoint wraps only the layer-scan body; the LM head is
+        # not replayed
+        total += L * per_layer
+    return total
+
 
 def main() -> None:
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import GPTConfig, gpt_loss, init_gpt_params
 
-    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    remat = os.environ.get("BENCH_RECOMPUTE", "full")  # "full" | "" (off)
+    remat = "" if remat in ("0", "none", "off") else remat
     cfg = GPTConfig(
         num_layers=24,
         hidden_size=1024,
@@ -33,7 +75,7 @@ def main() -> None:
         hidden_dropout=0.0,
         attention_dropout=0.0,
         compute_dtype=jnp.bfloat16,
-        recompute_granularity=os.environ.get("BENCH_RECOMPUTE") or None,
+        recompute_granularity=remat or None,
     )
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-4)
@@ -41,7 +83,6 @@ def main() -> None:
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
 
-    @jax.jit
     def train_step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(
             lambda p: gpt_loss(cfg, p, tokens, labels)
@@ -49,26 +90,49 @@ def main() -> None:
         params, opt_state = opt.step(grads, opt_state, params)
         return params, opt_state, loss
 
-    # warmup (compile)
+    train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # warmup (compile) — read the loss so compile+execute really finished
     for _ in range(2):
         params, opt_state, loss = train_step(params, opt_state, tokens, labels)
-    jax.block_until_ready(loss)
+    warm_loss = float(loss)
 
     iters = int(os.environ.get("BENCH_ITERS", "10"))
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = train_step(params, opt_state, tokens, labels)
-    jax.block_until_ready(loss)
+    final_loss = float(loss)  # true sync: forces the whole chained pipeline
     dt = time.perf_counter() - t0
+
+    if not math.isfinite(final_loss):
+        raise SystemExit(f"final loss is not finite: {final_loss}")
 
     tokens_per_sec = batch * seq * iters / dt
     step_ms = dt / iters * 1000.0
+    flops = train_flops_per_step(
+        cfg.num_layers, cfg.hidden_size, cfg.ffn_size, cfg.vocab_size,
+        batch, seq, causal=True, remat=bool(remat),
+    )
+    implied_tflops = flops / (dt / iters) / 1e12
+    peak = PEAK_TFLOPS.get(jax.default_backend(), 197.0)
+    mfu = implied_tflops / peak
+    if implied_tflops >= peak:
+        raise SystemExit(
+            f"implied {implied_tflops:.1f} TF/s exceeds chip peak {peak} — "
+            "the measurement is not timing real execution"
+        )
 
     vs_baseline = None
     try:
         with open(os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")) as f:
             base = json.load(f)
-        if base.get("unit") == "tokens/sec" and base.get("value"):
+        same_config = (
+            base.get("unit") == "tokens/sec"
+            and base.get("batch") == batch
+            and base.get("seq") == seq
+            and (base.get("recompute") or None) == (remat or None)
+        )
+        if same_config and base.get("value"):
             vs_baseline = tokens_per_sec / float(base["value"])
     except Exception:
         pass
@@ -81,8 +145,13 @@ def main() -> None:
                 "unit": "tokens/sec",
                 "vs_baseline": round(vs_baseline, 4) if vs_baseline else None,
                 "step_ms": round(step_ms, 2),
+                "final_loss": round(final_loss, 4),
+                "warmup_loss": round(warm_loss, 4),
+                "implied_tflops": round(implied_tflops, 2),
+                "mfu_vs_peak": round(mfu, 4),
                 "batch": batch,
                 "seq": seq,
+                "recompute": remat or None,
                 "backend": jax.default_backend(),
             }
         )
