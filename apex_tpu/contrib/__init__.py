@@ -1,20 +1,9 @@
 """apex_tpu.contrib: the production kernel/feature pack.
 
 TPU-native rebuild of ``apex/contrib`` (reference ~43.5k LoC of CUDA +
-Python wrappers). Subpackages mirror the reference's layout:
+Python wrappers). Subpackages, mirroring the reference's layout:
 
 - ``contrib.optimizers`` — ZeRO-2 sharded optimizers
-  (``DistributedFusedAdam``, ``DistributedFusedLAMB``)
-- ``contrib.xentropy`` — fused softmax cross entropy (label smoothing)
-- ``contrib.clip_grad`` — ``clip_grad_norm_`` over pytrees
-- ``contrib.group_norm`` — NHWC GroupNorm (+ swish) Pallas kernels
-- ``contrib.focal_loss`` — fused focal loss
-- ``contrib.index_mul_2d`` — fused ``out = in1[idx] * in2``
-- ``contrib.layer_norm`` — FastLayerNorm alias of the Pallas LN
-- ``contrib.transducer`` — RNN-T joint + loss
-- ``contrib.sparsity`` — ASP 2:4 structured sparsity
-- ``contrib.fmha`` / ``contrib.multihead_attn`` — fused attention over
-  the Pallas flash-attention kernels
-- ``contrib.bottleneck`` — spatial-parallel halo exchange
+  (``DistributedFusedAdam``, ``DistributedFusedLAMB``) + legacy aliases
 """
 from . import optimizers  # noqa: F401

@@ -1,0 +1,33 @@
+"""apex_tpu.contrib.optimizers — ZeRO-2 sharded optimizers.
+
+Reference: ``apex/contrib/optimizers/`` — ``DistributedFusedAdam`` (ZeRO-2,
+``distributed_fused_adam.py:273``), ``DistributedFusedLAMB``
+(``distributed_fused_lamb.py``), plus deprecated legacy copies of
+FusedAdam/FusedSGD and an ``FP16_Optimizer`` wrapper for them
+(``contrib/optimizers/fp16_optimizer.py``).
+
+The legacy trio were older duplicates of ``apex.optimizers`` kept for
+backward compatibility; here they are re-exports of the maintained
+implementations (``apex_tpu.optimizers`` / ``apex_tpu.fp16_utils``) so legacy
+import paths keep working without a second copy of the math.
+"""
+from .distributed_fused_adam import DistributedFusedAdam, DistributedFusedAdamState
+from .distributed_fused_lamb import DistributedFusedLAMB, DistributedFusedLAMBState
+
+# legacy aliases (reference apex/contrib/optimizers/{fused_adam,fused_sgd,
+# fp16_optimizer}.py — deprecated duplicates of the core packages)
+from ...optimizers.fused_adam import FusedAdam  # noqa: F401
+from ...optimizers.fused_sgd import FusedSGD  # noqa: F401
+from ...optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from ...fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+
+__all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedAdamState",
+    "DistributedFusedLAMB",
+    "DistributedFusedLAMBState",
+    "FusedAdam",
+    "FusedSGD",
+    "FusedLAMB",
+    "FP16_Optimizer",
+]

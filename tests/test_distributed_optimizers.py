@@ -1,0 +1,303 @@
+"""ZeRO-2 DistributedFusedAdam / DistributedFusedLAMB tests.
+
+Mirrors the reference's ``apex/contrib/test/optimizers/test_dist_adam.py``
+strategy: the distributed (sharded-state) optimizer must match the plain
+fused optimizer step-for-step, on an 8-virtual-device data-parallel mesh,
+plus checkpoint round-trip and the ZeRO memory property (state sharded 1/dp).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.contrib.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+DP = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def _toy_params(key, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w1": jax.random.normal(k1, (7, 5), dtype),
+        "b1": jax.random.normal(k2, (5,), dtype),
+        "w2": jax.random.normal(k3, (5, 3), dtype),
+    }
+
+
+def _loss(params, x, y):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    out = h @ params["w2"]
+    return jnp.mean((out - y) ** 2)
+
+
+def _make_batch(key, n=DP * 4):
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (n, 7), jnp.float32)
+    y = jax.random.normal(ky, (n, 3), jnp.float32)
+    return x, y
+
+
+def _dist_train_step(opt, mesh):
+    """Jitted DP train step: per-shard grads -> opt.step inside shard_map."""
+    specs = opt.state_specs()
+
+    def shard_fn(params, state, x, y):
+        grads = jax.grad(_loss)(params, x, y)
+        # opt averages grads over the axis itself (average_grad_sync)
+        return opt.step(grads, state, params)
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), specs, P("data"), P("data")),
+        out_specs=(P(), specs),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _ref_train_step(opt):
+    def fn(params, state, x, y):
+        grads = jax.grad(_loss)(params, x, y)
+        return opt.step(grads, state, params)
+
+    return jax.jit(fn)
+
+
+@pytest.mark.parametrize("adam_w_mode,weight_decay", [(True, 0.01), (False, 0.0)])
+def test_dist_adam_matches_fused_adam(adam_w_mode, weight_decay):
+    """dp=8 sharded step == single-device FusedAdam, several steps
+    (reference test_dist_adam.py main equivalence)."""
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(0))
+    dist = DistributedFusedAdam(
+        lr=1e-2, adam_w_mode=adam_w_mode, weight_decay=weight_decay,
+        distributed_size=DP,
+    )
+    ref = FusedAdam(lr=1e-2, adam_w_mode=adam_w_mode, weight_decay=weight_decay)
+
+    d_state = dist.init(params)
+    r_state = ref.init(params)
+    d_params = params
+    r_params = params
+    d_step = _dist_train_step(dist, mesh)
+    r_step = _ref_train_step(ref)
+
+    for i in range(5):
+        x, y = _make_batch(jax.random.PRNGKey(100 + i))
+        d_params, d_state = d_step(d_params, d_state, x, y)
+        r_params, r_state = r_step(r_params, r_state, x, y)
+
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(d_params[k]), np.asarray(r_params[k]), rtol=2e-5, atol=2e-6
+        )
+    assert int(d_state.step) == 5
+
+
+def test_dist_adam_state_is_sharded():
+    """ZeRO property: each device holds 1/dp of each state buffer."""
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(1))
+    dist = DistributedFusedAdam(lr=1e-2, distributed_size=DP)
+    state = dist.init(params)
+    x, y = _make_batch(jax.random.PRNGKey(2))
+    new_params, new_state = _dist_train_step(dist, mesh)(params, state, x, y)
+
+    layout = dist.layout_for(params)
+    assert layout.padded % DP == 0
+    for buf in (new_state.exp_avg, new_state.exp_avg_sq, new_state.param_shard):
+        assert buf.shape == (layout.padded,)
+        shard_shapes = {s.data.shape for s in buf.addressable_shards}
+        assert shard_shapes == {(layout.shard_size,)}, (
+            "optimizer state must be sharded 1/dp over the mesh"
+        )
+
+
+def test_dist_adam_overflow_skips_step():
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(3))
+    dist = DistributedFusedAdam(lr=1e-2, distributed_size=DP)
+    state = dist.init(params)
+    specs = dist.state_specs()
+
+    def shard_fn(params, state, x, y, found_inf):
+        grads = jax.grad(_loss)(params, x, y)
+        return dist.step(grads, state, params, found_inf=found_inf)
+
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), specs, P("data"), P("data"), P()),
+        out_specs=(P(), specs), check_vma=False,
+    ))
+    x, y = _make_batch(jax.random.PRNGKey(4))
+    new_params, new_state = step(params, state, x, y, jnp.bool_(True))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(new_params[k]), np.asarray(params[k]))
+    assert int(new_state.step) == 0
+
+
+def test_dist_adam_grad_scale_and_clip():
+    """grad_scale unscaling + max_grad_norm clip match a manual reference."""
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(5))
+    scale = 128.0
+    max_norm = 0.05
+    dist = DistributedFusedAdam(
+        lr=1e-2, distributed_size=DP, max_grad_norm=max_norm
+    )
+    state = dist.init(params)
+    specs = dist.state_specs()
+
+    def shard_fn(params, state, x, y):
+        grads = jax.grad(lambda p, x, y: _loss(p, x, y) * scale)(params, x, y)
+        return dist.step(grads, state, params, grad_scale=scale)
+
+    step = jax.jit(shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), specs, P("data"), P("data")),
+        out_specs=(P(), specs), check_vma=False,
+    ))
+    x, y = _make_batch(jax.random.PRNGKey(6))
+    d_params, _ = step(params, state, x, y)
+
+    # manual: mean grads, clip to max_norm, plain Adam step
+    grads = jax.grad(_loss)(params, x, y)
+    gnorm = jnp.sqrt(sum(jnp.sum(g ** 2) for g in jax.tree_util.tree_leaves(grads)))
+    coef = jnp.minimum(1.0, max_norm / gnorm)
+    clipped = jax.tree_util.tree_map(lambda g: g * coef, grads)
+    ref = FusedAdam(lr=1e-2)
+    r_params, _ = ref.step(clipped, ref.init(params), params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(d_params[k]), np.asarray(r_params[k]), rtol=2e-5, atol=2e-6
+        )
+
+
+@pytest.mark.parametrize("format", ["v1", "v2"])
+def test_dist_adam_checkpoint_roundtrip(format):
+    """Sharded state_dict v1/v2 round-trips and training continues identically
+    (reference sharded checkpoints distributed_fused_adam.py:2956-3555)."""
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(7))
+    dist = DistributedFusedAdam(lr=1e-2, distributed_size=DP)
+    state = dist.init(params)
+    step = _dist_train_step(dist, mesh)
+
+    x, y = _make_batch(jax.random.PRNGKey(8))
+    params1, state1 = step(params, state, x, y)
+
+    sd = dist.state_dict(state1, format=format)
+    if format == "v2":
+        assert sd["exp_avg"].shape == (DP, dist.layout_for(params).shard_size)
+    restored = dist.load_state_dict(sd)
+
+    x2, y2 = _make_batch(jax.random.PRNGKey(9))
+    p_a, s_a = step(params1, state1, x2, y2)
+    p_b, s_b = step(params1, restored, x2, y2)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_a[k]), np.asarray(p_b[k]), rtol=1e-6)
+    assert int(s_b.step) == 2
+
+
+def test_dist_adam_bf16_params_master_weights():
+    """bf16 model params + fp32 sharded masters: matches FusedAdam with
+    master_weights=True."""
+    mesh = _mesh()
+    params32 = _toy_params(jax.random.PRNGKey(10))
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params32)
+    dist = DistributedFusedAdam(lr=1e-2, distributed_size=DP)
+    ref = FusedAdam(lr=1e-2, master_weights=True)
+    d_state = dist.init(params)
+    r_state = ref.init(params)
+    d_step = _dist_train_step(dist, mesh)
+    r_step = _ref_train_step(ref)
+    d_params, r_params = params, params
+    for i in range(3):
+        x, y = _make_batch(jax.random.PRNGKey(200 + i))
+        d_params, d_state = d_step(d_params, d_state, x, y)
+        r_params, r_state = r_step(r_params, r_state, x, y)
+    for k in params:
+        assert d_params[k].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(d_params[k], np.float32),
+            np.asarray(r_params[k], np.float32),
+            rtol=2e-2, atol=1e-3,
+        )
+    # masters stay fp32 and track the reference's masters. Tolerance is
+    # bf16-level: grads are rounded to bf16 per-device (batch 4) here but
+    # once full-batch (32) in the reference, so inputs to the two optimizers
+    # differ by bf16 rounding.
+    np.testing.assert_allclose(
+        np.asarray(d_state.param_shard[5 : 5 + 7 * 5]),
+        np.asarray(r_state.master_params["w1"]).reshape(-1),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+@pytest.mark.parametrize("use_nvlamb,weight_decay", [(False, 0.01), (True, 0.0)])
+def test_dist_lamb_matches_fused_lamb(use_nvlamb, weight_decay):
+    """dp=8 sharded LAMB == single-device FusedLAMB (trust ratios exact via
+    segment-sum psum)."""
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(11))
+    dist = DistributedFusedLAMB(
+        lr=1e-2, weight_decay=weight_decay, use_nvlamb=use_nvlamb,
+        max_grad_norm=1.0, distributed_size=DP,
+    )
+    ref = FusedLAMB(
+        lr=1e-2, weight_decay=weight_decay, use_nvlamb=use_nvlamb,
+        max_grad_norm=1.0,
+    )
+    d_state = dist.init(params)
+    r_state = ref.init(params)
+    d_step = _dist_train_step(dist, mesh)
+    r_step = _ref_train_step(ref)
+    d_params, r_params = params, params
+    for i in range(4):
+        x, y = _make_batch(jax.random.PRNGKey(300 + i))
+        d_params, d_state = d_step(d_params, d_state, x, y)
+        r_params, r_state = r_step(r_params, r_state, x, y)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(d_params[k]), np.asarray(r_params[k]), rtol=5e-5, atol=5e-6
+        )
+
+
+def test_dist_lamb_checkpoint_roundtrip():
+    mesh = _mesh()
+    params = _toy_params(jax.random.PRNGKey(12))
+    dist = DistributedFusedLAMB(lr=1e-2, distributed_size=DP)
+    state = dist.init(params)
+    step = _dist_train_step(dist, mesh)
+    x, y = _make_batch(jax.random.PRNGKey(13))
+    params1, state1 = step(params, state, x, y)
+    restored = dist.load_state_dict(dist.state_dict(state1))
+    x2, y2 = _make_batch(jax.random.PRNGKey(14))
+    p_a, _ = step(params1, state1, x2, y2)
+    p_b, _ = step(params1, restored, x2, y2)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_a[k]), np.asarray(p_b[k]), rtol=1e-6)
+
+
+def test_contrib_imports():
+    """ADVICE r2 medium: every advertised contrib name must import."""
+    import apex_tpu.contrib as contrib
+
+    assert contrib.optimizers.DistributedFusedAdam is not None
+    assert contrib.optimizers.DistributedFusedLAMB is not None
+    # legacy aliases (reference apex/contrib/optimizers legacy copies)
+    assert contrib.optimizers.FusedAdam is not None
+    assert contrib.optimizers.FP16_Optimizer is not None
