@@ -1,0 +1,72 @@
+"""Global gradient-norm clipping over pytrees.
+
+Reference: ``apex/contrib/clip_grad/clip_grad.py:16-100`` — a drop-in
+``torch.nn.utils.clip_grad_norm_`` that routes the 2-norm through the fused
+``multi_tensor_l2norm`` kernel and scales grads in place with
+``multi_tensor_scale``.
+
+Functional spelling: gradients are values, not ``.grad`` slots, so the
+function returns ``(clipped_grads, total_norm)`` instead of mutating.
+The fused-kernel path is :func:`apex_tpu.ops.multi_tensor.multi_tensor_l2norm`
+(one jit-fused reduction over the whole pytree).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.multi_tensor import multi_tensor_l2norm
+
+Pytree = Any
+
+
+def clip_grad_norm_(
+    grads: Pytree,
+    max_norm: float,
+    norm_type: float = 2.0,
+    error_if_nonfinite: bool = False,
+) -> Tuple[Pytree, jax.Array]:
+    """Clip the global ``norm_type``-norm of ``grads`` to ``max_norm``.
+
+    Returns ``(clipped_grads, total_norm)`` — total_norm is the pre-clip
+    norm, as in the reference. ``norm_type`` may be ``inf``.
+
+    ``error_if_nonfinite``: the reference raises on a nan/inf norm. Inside
+    ``jit`` values are abstract, so raising is impossible; instead the clip
+    coefficient propagates the non-finite norm into the grads exactly like
+    ``torch.nn.utils.clip_grad_norm_(error_if_nonfinite=False)`` does.
+    Callers that want the hard error should check the returned norm outside
+    jit (or via ``jax.experimental.checkify``).
+    """
+    if error_if_nonfinite:
+        raise NotImplementedError(
+            "error_if_nonfinite=True cannot raise from inside jit; check the "
+            "returned total_norm instead (see docstring)"
+        )
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return grads, jnp.float32(0.0)
+    max_norm = float(max_norm)
+    norm_type = float(norm_type)
+
+    if norm_type == 2.0:
+        total_norm, _ = multi_tensor_l2norm(grads)
+    elif math.isinf(norm_type):
+        total_norm = jnp.max(
+            jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves])
+        )
+    else:
+        total_norm = (
+            sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in leaves)
+            ** (1.0 / norm_type)
+        )
+
+    # torch semantics: clip_coef = max_norm / (norm + 1e-6), applied only when < 1
+    clip_coef = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads
+    )
+    return clipped, total_norm

@@ -1,0 +1,29 @@
+"""Fused ``out = in1[idx] * in2`` (gather-multiply).
+
+Reference: ``apex/contrib/index_mul_2d/index_mul_2d.py`` over
+``csrc/index_mul_2d/`` — forward, backward (scatter-add into ``grad_in1``)
+and double-backward CUDA kernels for the OpenFold evoformer gating pattern.
+
+One jnp expression: XLA fuses the gather into the multiply; the backward's
+scatter-add is the autodiff transpose of the gather (``segment_sum``), and
+double-backward falls out of composing ``jax.grad`` — all three hand-written
+CUDA kernels are subsumed. Shape/dtype contract checks mirror the
+reference's (2D tensors, matching dtypes fp32/fp16/bf16, 1D int index).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1: jax.Array, in2: jax.Array, idx1: jax.Array) -> jax.Array:
+    """``out[i, :] = in1[idx1[i], :] * in2[i, :]``."""
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise RuntimeError("in1 and in2 must be 2-dimension tensors.")
+    if idx1.ndim != 1:
+        raise RuntimeError("idx1 must be a 1-dimension tensor.")
+    if in2.shape[0] != idx1.shape[0]:
+        raise RuntimeError("in2 and idx1 must agree on dim 0.")
+    if in1.dtype != in2.dtype:
+        raise RuntimeError("input1's dtype and input2's dtype must be the same.")
+    return in1[idx1] * in2

@@ -1,0 +1,48 @@
+"""contrib FastLayerNorm — the high-performance LN entry point.
+
+Reference: ``apex/contrib/layer_norm/layer_norm.py`` over
+``csrc/layer_norm/`` (~2k LoC of persistent/semi-persistent CUDA kernels
+tuned for hidden sizes up to 65k). On TPU the same capability is the Pallas
+LayerNorm in ``apex_tpu.ops.layer_norm`` (fwd+bwd row-block kernels, whole
+hidden in VMEM — the same envelope the FastLayerNorm kernels target), so the
+contrib module is the core kernel behind the reference's contrib API shape:
+``FastLayerNormFN.apply(x, gamma, beta, eps, memory_efficient)`` and the
+``FastLayerNorm(hidden_size)`` module with fp32 ones/zeros params.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...ops.layer_norm import layer_norm
+
+
+class FastLayerNormFN:
+    """Autograd-Function parity shim (``layer_norm.py:9-35``)."""
+
+    @staticmethod
+    def apply(x, gamma, beta, epsilon=1e-5, memory_efficient=False):
+        return layer_norm(
+            x, gamma, beta, normalized_ndim=gamma.ndim, eps=epsilon,
+            memory_efficient=memory_efficient,
+        )
+
+
+class FastLayerNorm(nn.Module):
+    """Module parity with ``contrib.layer_norm.FastLayerNorm``
+    (``layer_norm.py:43-57``)."""
+
+    hidden_size: int
+    eps: float = 1e-5
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param(
+            "weight", nn.initializers.ones, (self.hidden_size,), self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.hidden_size,), self.param_dtype
+        )
+        return FastLayerNormFN.apply(x, weight, bias, self.eps, self.memory_efficient)

@@ -1,0 +1,110 @@
+"""ASP — Automatic SParsity (2:4 structured sparsity workflow).
+
+Reference: ``apex/contrib/sparsity/asp.py:28-310``. The reference workflow:
+
+1. ``ASP.init_model_for_pruning(model, "m4n2_1d", whitelist=...)`` tags
+   whitelisted module params with mask buffers;
+2. ``ASP.init_optimizer_for_pruning(optimizer)`` monkey-patches
+   ``optimizer.step`` so masks are re-applied after every update
+   (``asp.py:313-336``);
+3. ``ASP.compute_sparse_masks()`` fills the masks from the current weights.
+
+Functional JAX spelling — params are values and the optimizer step is a pure
+function, so "buffers + patched step" becomes "a masks pytree + a wrapped
+step function":
+
+    asp = ASP(mask_calculator="m4n2_1d",
+              whitelist=lambda path, p: p.ndim == 2 and "embed" not in path)
+    masks = asp.compute_sparse_masks(params)     # step 1+3
+    params = asp.apply_masks(params, masks)      # prune now
+    step = asp.wrap_step(opt.step, masks)        # step 2: masks re-applied
+    new_params, new_state = step(grads, state, params)
+
+The reference's channel-permutation search (``permutation_lib.py``, a
+GPU-accelerated accuracy-preserving channel reordering) is an offline
+preprocessing tool; it is not ported — ``allow_permutation`` is accepted and
+must be False.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_masklib import create_mask
+
+Pytree = Any
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        name = getattr(p, "key", None)
+        if name is None:
+            name = getattr(p, "idx", None)
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+class ASP:
+    """Pytree-functional ASP manager (see module docstring).
+
+    Args:
+        mask_calculator: pattern string (``"m4n2_1d"``) or a callable
+            ``param -> mask`` (reference ``asp.py:86-93``).
+        whitelist: predicate ``(path_str, param) -> bool`` selecting params to
+            sparsify; default prunes every rank>=2 param whose last dim is a
+            multiple of 4 (the reference's TC-compatibility check,
+            ``asp.py:121-126``).
+        allow_permutation: must be False (permutation search not ported).
+    """
+
+    def __init__(
+        self,
+        mask_calculator: Union[str, Callable] = "m4n2_1d",
+        whitelist: Optional[Callable[[str, jax.Array], bool]] = None,
+        verbosity: int = 0,
+        allow_permutation: bool = False,
+    ):
+        if allow_permutation:
+            raise NotImplementedError(
+                "channel-permutation search (permutation_lib) is an offline "
+                "tool not ported to TPU; pass allow_permutation=False"
+            )
+        if isinstance(mask_calculator, str):
+            pattern = mask_calculator
+            self._calc = lambda p: create_mask(p, pattern)
+        else:
+            self._calc = mask_calculator
+        self._whitelist = whitelist or (
+            lambda path, p: p.ndim >= 2 and p.shape[-1] % 4 == 0
+        )
+        self.verbosity = verbosity
+
+    def _is_sparse(self, path, p) -> bool:
+        return bool(self._whitelist(_path_str(path), p))
+
+    def compute_sparse_masks(self, params: Pytree) -> Pytree:
+        """Masks pytree: 0/1 mask for whitelisted leaves, ``None`` markers
+        replaced by all-ones for the rest (keeps tree structure jit-friendly)."""
+        def leaf(path, p):
+            if self._is_sparse(path, p):
+                return self._calc(p)
+            return jnp.ones_like(p)
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def apply_masks(self, params: Pytree, masks: Pytree) -> Pytree:
+        return jax.tree_util.tree_map(lambda p, m: p * m, params, masks)
+
+    def wrap_step(self, step_fn: Callable, masks: Pytree) -> Callable:
+        """Re-apply masks to the params returned by an optimizer step — the
+        functional analogue of the patched ``optimizer.step``
+        (``asp.py:313-336``). Works with any ``step(grads, state, params,
+        **kw) -> (new_params, new_state)``."""
+        def stepped(grads, state, params, **kw):
+            new_params, new_state = step_fn(grads, state, params, **kw)
+            return self.apply_masks(new_params, masks), new_state
+
+        return stepped

@@ -1,0 +1,77 @@
+"""m:n structured-sparsity mask calculators.
+
+Reference: ``apex/contrib/sparsity/sparse_masklib.py`` — per-tensor 2:4
+pattern search. Semantics replicated exactly:
+
+- ``mn_1d_best`` (``sparse_masklib.py:37-47``): view the matrix as rows of
+  ``m``-element groups, score every valid m:n keep-pattern by the sum of kept
+  ``|w|``, take the argmax per group. For 4:2 this is "keep the 2 largest of
+  every 4", expressed as the same enumerate-6-patterns matmul the reference
+  uses (vectorizes cleanly on TPU; ties resolve identically).
+- ``create_mask`` (``:145-185``) dim handling: 1D -> (1, n); 2D (K, C)
+  pruned along C; 3D conv (K, C, R) permuted to (K*R, C); 4D conv
+  (K, C, R, S) permuted to (R*S*K, C) — pruning always runs along the
+  input-channel direction.
+
+Masks are returned in the input dtype (1.0/0.0), like the reference's
+``.type(ttype)``.
+"""
+from __future__ import annotations
+
+from itertools import permutations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _valid_patterns(m: int, n: int) -> np.ndarray:
+    base = [1.0] * n + [0.0] * (m - n)
+    pats = sorted(set(permutations(base)))
+    return np.asarray(pats, np.float32)
+
+
+def mn_1d_best(matrix: jnp.ndarray, m: int, n: int) -> jnp.ndarray:
+    """Best m:n pattern per m-element group along the last dim."""
+    rows, cols = matrix.shape
+    pad = (-cols) % m
+    mat = jnp.pad(matrix.astype(jnp.float32), ((0, 0), (0, pad)))
+    groups = jnp.abs(mat).reshape(-1, m)
+    patterns = jnp.asarray(_valid_patterns(m, n))
+    scores = groups @ patterns.T  # (G, n_patterns)
+    best = jnp.argmax(scores, axis=1)
+    mask = patterns[best].reshape(rows, cols + pad)[:, :cols]
+    return mask
+
+
+def m4n2_1d(mat, density=0.5):
+    del density  # fixed by the 4:2 pattern (reference signature parity)
+    return mn_1d_best(mat, 4, 2)
+
+
+_PATTERN_FUNCS = {"m4n2_1d": m4n2_1d}
+
+
+def create_mask(tensor: jnp.ndarray, pattern: str = "m4n2_1d", density: float = 0.5):
+    """Reference ``create_mask`` (``sparse_masklib.py:145-185``): dispatch on
+    rank, prune along the input-channel direction, return a 0/1 mask in the
+    tensor's dtype."""
+    if pattern not in _PATTERN_FUNCS:
+        raise ValueError(f"unknown sparsity pattern {pattern!r}")
+    func = _PATTERN_FUNCS[pattern]
+    t = tensor.astype(jnp.float32)
+    shape = tensor.shape
+    if t.ndim == 1:
+        mask = func(t.reshape(1, -1), density).reshape(shape)
+    elif t.ndim == 2:  # linear (K, C): prune along C
+        mask = func(t, density)
+    elif t.ndim == 3:  # conv1d (K, C, R): prune along C
+        k, c, r = shape
+        tm = jnp.transpose(t, (0, 2, 1)).reshape(k * r, c)
+        mask = func(tm, density).reshape(k, r, c).transpose(0, 2, 1)
+    elif t.ndim == 4:  # conv2d (K, C, R, S): prune along C
+        k, c, r, s = shape
+        tm = jnp.transpose(t, (2, 3, 0, 1)).reshape(r * s * k, c)
+        mask = func(tm, density).reshape(r, s, k, c).transpose(2, 3, 0, 1)
+    else:
+        raise ValueError(f"unsupported tensor rank {t.ndim}")
+    return mask.astype(tensor.dtype)

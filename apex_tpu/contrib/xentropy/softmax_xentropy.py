@@ -1,0 +1,56 @@
+"""Fused softmax cross entropy with label smoothing.
+
+Reference: ``apex/contrib/xentropy/softmax_xentropy.py:6-30`` over
+``csrc/xentropy/xentropy_kernel.cu`` (718 LoC). The kernel's exact loss
+(``xentropy_kernel.cu:428-429``)::
+
+    loss = smoothing * (logsumexp(x) - mean(x)) + (1-smoothing) * (logsumexp(x) - x[label])
+
+i.e. cross entropy against the mixture target ``(1-s)*onehot + s/K``.
+Positions with ``label == padding_idx`` contribute zero loss and zero
+gradient (the reference masks both fwd and bwd).
+
+The CUDA kernel exists to (a) fuse max/sum-exp/gather into one pass and
+(b) save only ``max_log_sum_exp`` for backward instead of the softmax
+probabilities (in-place bwd). Under XLA, (a) is one fusion already, and (b)
+is exactly what a ``jax.checkpoint`` of this function provides — the saved
+residual is the logits; probabilities are never materialised in fp32 unless
+the scheduler chooses to. ``half_to_float`` upcasts the returned losses (the
+kernel always produces fp32 losses; the flag controls the saved softmax
+dtype, moot here).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    smoothing: float = 0.0,
+    padding_idx: int = 0,
+    half_to_float: bool = False,
+) -> jax.Array:
+    """Per-example smoothed CE; ``(N, K)`` logits + ``(N,)`` int labels ->
+    ``(N,)`` fp32 losses, zeroed where ``labels == padding_idx``."""
+    del half_to_float  # losses are always fp32 (kernel parity)
+    x = logits.astype(jnp.float32)
+    n, k = x.shape
+    lse = jax.nn.logsumexp(x, axis=-1)
+    picked = jnp.take_along_axis(x, labels[:, None], axis=-1)[:, 0]
+    loss = smoothing * (lse - jnp.mean(x, axis=-1)) + (1.0 - smoothing) * (
+        lse - picked
+    )
+    return jnp.where(labels == padding_idx, 0.0, loss)
+
+
+class SoftmaxCrossEntropyLoss:
+    """``.apply`` parity shim for the reference autograd-Function spelling
+    (``SoftmaxCrossEntropyLoss.apply(logits, labels, ...)``)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0, half_to_float=False):
+        return softmax_cross_entropy_loss(
+            logits, labels, smoothing, padding_idx, half_to_float
+        )
