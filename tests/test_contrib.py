@@ -1,0 +1,334 @@
+"""Tests for the contrib kernel pack.
+
+Mirrors reference contrib suites (``apex/contrib/test/``): each component
+vs an independent reference implementation — torch CPU where the reference
+compares against torch modules (group_norm, clip_grad), hand numpy math
+elsewhere (xentropy, focal_loss, sparsity).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.focal_loss import FocalLoss, focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.layer_norm import FastLayerNorm, FastLayerNormFN
+from apex_tpu.contrib.sparsity import ASP, create_mask
+from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss, softmax_cross_entropy_loss
+
+
+# ---------------------------------------------------------------- clip_grad
+
+
+def _rand_tree(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return {
+        "w": jax.random.normal(ks[0], (5, 7)),
+        "b": jax.random.normal(ks[1], (7,)) * 3.0,
+        "nested": [jax.random.normal(ks[2], (2, 3, 4))],
+    }
+
+
+def test_clip_grad_norm_matches_torch():
+    grads = _rand_tree()
+    tleaves = [torch.tensor(np.asarray(g), requires_grad=True)
+               for g in jax.tree_util.tree_leaves(grads)]
+    for t in tleaves:
+        t.grad = t.detach().clone()
+    max_norm = 1.7
+    tnorm = torch.nn.utils.clip_grad_norm_(tleaves, max_norm)
+
+    clipped, norm = clip_grad_norm_(grads, max_norm)
+    np.testing.assert_allclose(float(norm), float(tnorm), rtol=1e-6)
+    for ours, t in zip(jax.tree_util.tree_leaves(clipped), tleaves):
+        np.testing.assert_allclose(np.asarray(ours), t.grad.numpy(), rtol=1e-5)
+
+
+def test_clip_grad_norm_no_clip_below_threshold():
+    grads = {"a": jnp.ones((2, 2)) * 0.1}
+    clipped, norm = clip_grad_norm_(grads, 100.0)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(norm), 0.2, rtol=1e-6)
+
+
+def test_clip_grad_norm_inf_norm():
+    grads = {"a": jnp.array([1.0, -5.0]), "b": jnp.array([[3.0]])}
+    clipped, norm = clip_grad_norm_(grads, 1.0, norm_type=math.inf)
+    assert float(norm) == 5.0
+    np.testing.assert_allclose(np.asarray(clipped["a"]),
+                               np.array([0.2, -1.0]), rtol=1e-5)
+
+
+def test_clip_grad_norm_jits():
+    grads = _rand_tree(1)
+    f = jax.jit(lambda g: clip_grad_norm_(g, 1.0))
+    clipped, norm = f(grads)
+    ref_norm = math.sqrt(sum(float(jnp.sum(g.astype(jnp.float32) ** 2))
+                             for g in jax.tree_util.tree_leaves(grads)))
+    np.testing.assert_allclose(float(norm), ref_norm, rtol=1e-5)
+    del clipped
+
+
+# ----------------------------------------------------------------- xentropy
+
+
+def _np_smoothed_ce(logits, labels, smoothing, padding_idx):
+    x = np.asarray(logits, np.float64)
+    lse = np.log(np.sum(np.exp(x - x.max(-1, keepdims=True)), -1)) + x.max(-1)
+    picked = x[np.arange(len(labels)), labels]
+    loss = smoothing * (lse - x.mean(-1)) + (1 - smoothing) * (lse - picked)
+    loss[np.asarray(labels) == padding_idx] = 0.0
+    return loss
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_vs_numpy(smoothing):
+    k = jax.random.PRNGKey(3)
+    logits = jax.random.normal(k, (9, 13)) * 4.0
+    labels = jnp.array([0, 1, 5, 12, 3, 0, 7, 2, 9])
+    ours = softmax_cross_entropy_loss(logits, labels, smoothing, padding_idx=0)
+    ref = _np_smoothed_ce(logits, labels, smoothing, 0)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-5, atol=1e-6)
+    # padding rows (label==0) give zero loss AND zero gradient
+    g = jax.grad(lambda lg: jnp.sum(
+        softmax_cross_entropy_loss(lg, labels, smoothing, 0)))(logits)
+    assert float(jnp.abs(g[0]).max()) == 0.0 and float(jnp.abs(g[5]).max()) == 0.0
+    assert float(jnp.abs(g[1]).max()) > 0.0
+
+
+def test_xentropy_apply_shim_and_torch_parity():
+    # smoothing=0, no padding hit -> plain torch F.cross_entropy(reduction=none)
+    logits = jax.random.normal(jax.random.PRNGKey(0), (6, 11))
+    labels = jnp.array([1, 2, 3, 4, 5, 10])
+    ours = SoftmaxCrossEntropyLoss.apply(logits, labels, 0.0, padding_idx=-100)
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(np.asarray(logits)),
+        torch.tensor(np.asarray(labels), dtype=torch.long),
+        reduction="none")
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-5)
+
+
+# --------------------------------------------------------------- group_norm
+
+
+@pytest.mark.parametrize("act", [None, "swish"])
+def test_group_norm_nhwc_vs_torch(act):
+    n, h, w, c, g = 2, 5, 6, 16, 4
+    x = jax.random.normal(jax.random.PRNGKey(7), (n, h, w, c))
+    weight = jax.random.normal(jax.random.PRNGKey(8), (c,)) * 0.2 + 1.0
+    bias = jax.random.normal(jax.random.PRNGKey(9), (c,)) * 0.1
+    y = group_norm_nhwc(x, g, weight, bias, eps=1e-5, act=act)
+
+    tx = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)  # NHWC -> NCHW
+    gn = torch.nn.GroupNorm(g, c, eps=1e-5)
+    with torch.no_grad():
+        gn.weight.copy_(torch.tensor(np.asarray(weight)))
+        gn.bias.copy_(torch.tensor(np.asarray(bias)))
+    ty = gn(tx)
+    if act == "swish":
+        ty = ty * torch.sigmoid(ty)
+    ty = ty.permute(0, 2, 3, 1).detach().numpy()
+    np.testing.assert_allclose(np.asarray(y), ty, rtol=1e-4, atol=1e-5)
+
+
+def test_group_norm_module_and_grads():
+    m = GroupNorm(num_groups=2, num_channels=8, act="silu")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 3, 8))
+    v = m.init(jax.random.PRNGKey(1), x)
+    y, grads = jax.value_and_grad(
+        lambda vv: jnp.sum(m.apply(vv, x) ** 2))(v)
+    assert np.isfinite(float(y))
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    with pytest.raises(ValueError):
+        m.apply(v, jnp.zeros((1, 2, 2, 4)))
+
+
+def test_group_norm_bad_args():
+    x = jnp.zeros((1, 2, 2, 6))
+    with pytest.raises(ValueError):
+        group_norm_nhwc(x, 4, None, None)  # 6 % 4 != 0
+    with pytest.raises(ValueError):
+        group_norm_nhwc(x, 2, None, None, act="relu")
+
+
+# --------------------------------------------------------------- focal_loss
+
+
+def _np_focal(logits, targets, npos, num_real, alpha, gamma, smoothing):
+    p = np.asarray(logits, np.float64)
+    y = np.asarray(targets)
+    ncls = p.shape[-1]
+    ids = np.arange(ncls)
+    is_pos = (y[..., None] == ids) & (y[..., None] >= 0)
+    t = np.where(is_pos, 1 - smoothing + smoothing / 2, smoothing / 2)
+    sig = 1 / (1 + np.exp(-p))
+    bce = -t * np.log(sig) - (1 - t) * np.log1p(-sig)
+    coeff = np.where(is_pos, alpha * (1 - sig) ** gamma, (1 - alpha) * sig ** gamma)
+    elem = coeff * bce
+    valid = (y[..., None] != -2) & (ids < num_real)
+    return np.sum(np.where(valid, elem, 0.0)) / npos
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_focal_loss_vs_numpy(smoothing):
+    k = jax.random.PRNGKey(11)
+    logits = jax.random.normal(k, (4, 6, 8)) * 2.0  # padded to 8, 7 real
+    targets = jnp.array([[0, 3, -1, 6, -2, 2],
+                         [1, -1, -1, 5, 0, -2],
+                         [-1, -1, -1, -1, -1, -1],
+                         [4, 4, 4, -2, -2, 0]])
+    npos = 9.0
+    ours = focal_loss(logits, targets, jnp.float32(npos), 7, 0.25, 2.0, smoothing)
+    ref = _np_focal(logits, targets, npos, 7, 0.25, 2.0, smoothing)
+    np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+
+def test_focal_loss_ignore_and_padding_have_no_grad():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
+    targets = jnp.array([[0, -2, 1], [-2, -2, 2]])
+    g = jax.grad(lambda lg: FocalLoss.apply(
+        lg, targets, jnp.float32(3.0), 6, 0.25, 2.0))(logits)
+    # ignored examples (-2): zero grad everywhere
+    assert float(jnp.abs(g[0, 1]).max()) == 0.0
+    assert float(jnp.abs(g[1, 0]).max()) == 0.0
+    # padded classes (>= num_real_classes=6): zero grad
+    assert float(jnp.abs(g[..., 6:]).max()) == 0.0
+    assert float(jnp.abs(g[0, 0, :6]).max()) > 0.0
+
+
+# ------------------------------------------------------------- index_mul_2d
+
+
+def test_index_mul_2d_forward_and_grads():
+    in1 = jax.random.normal(jax.random.PRNGKey(0), (5, 4))
+    in2 = jax.random.normal(jax.random.PRNGKey(1), (7, 4))
+    idx = jnp.array([0, 2, 2, 4, 1, 0, 3])
+    out = index_mul_2d(in1, in2, idx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(in1)[np.asarray(idx)]
+                               * np.asarray(in2), rtol=1e-6)
+
+    # backward: grad_in1 is a scatter-add over duplicate indices
+    g1, g2 = jax.grad(lambda a, b: jnp.sum(index_mul_2d(a, b, idx) ** 2),
+                      argnums=(0, 1))(in1, in2)
+    n1, n2, nidx = map(np.asarray, (in1, in2, idx))
+    ref_g1 = np.zeros_like(n1)
+    for i, j in enumerate(nidx):
+        ref_g1[j] += 2 * (n1[j] * n2[i]) * n2[i]
+    np.testing.assert_allclose(np.asarray(g1), ref_g1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g2), 2 * n1[nidx] * n2 * n1[nidx],
+                               rtol=1e-5, atol=1e-6)
+
+    # double backward exists (reference ships a dedicated kernel for it)
+    h = jax.grad(lambda a: jnp.sum(jax.grad(
+        lambda aa: jnp.sum(index_mul_2d(aa, in2, idx) ** 2))(a) ** 2))(in1)
+    assert np.all(np.isfinite(np.asarray(h)))
+
+
+def test_index_mul_2d_contract_checks():
+    with pytest.raises(RuntimeError):
+        index_mul_2d(jnp.zeros((2, 2, 2)), jnp.zeros((2, 2)), jnp.array([0]))
+    with pytest.raises(RuntimeError):
+        index_mul_2d(jnp.zeros((2, 2)), jnp.zeros((3, 2)), jnp.array([0, 1]))
+    with pytest.raises(RuntimeError):
+        index_mul_2d(jnp.zeros((2, 2)), jnp.zeros((2, 2), jnp.bfloat16),
+                     jnp.array([0, 1]))
+
+
+# ----------------------------------------------------------------- sparsity
+
+
+def test_create_mask_m4n2_keeps_two_largest_of_four():
+    w = jnp.array([[0.1, -5.0, 3.0, 0.2, 1.0, 2.0, -3.0, 0.0]])
+    mask = create_mask(w, "m4n2_1d")
+    np.testing.assert_array_equal(
+        np.asarray(mask), [[0, 1, 1, 0, 0, 1, 1, 0]])
+    assert mask.dtype == w.dtype
+
+
+@pytest.mark.parametrize("shape", [(8,), (6, 8), (6, 8, 3), (6, 8, 3, 3)])
+def test_create_mask_density_and_rank_dispatch(shape):
+    w = jax.random.normal(jax.random.PRNGKey(2), shape)
+    mask = create_mask(w, "m4n2_1d")
+    assert mask.shape == w.shape
+    np.testing.assert_allclose(float(jnp.mean(mask)), 0.5)
+    # every 4-group along the input-channel direction has exactly 2 kept
+    if len(shape) == 2:
+        groups = np.asarray(mask).reshape(-1, 4)
+        np.testing.assert_array_equal(groups.sum(1), 2)
+
+
+def test_asp_workflow_and_wrapped_step():
+    from apex_tpu.optimizers import FusedSGD
+
+    params = {"dense": jax.random.normal(jax.random.PRNGKey(0), (8, 8)),
+              "bias": jnp.ones((8,))}
+    asp = ASP(mask_calculator="m4n2_1d",
+              whitelist=lambda path, p: p.ndim == 2)
+    masks = asp.compute_sparse_masks(params)
+    np.testing.assert_allclose(float(jnp.mean(masks["dense"])), 0.5)
+    np.testing.assert_allclose(np.asarray(masks["bias"]), 1.0)  # not whitelisted
+
+    pruned = asp.apply_masks(params, masks)
+    assert float(jnp.sum(pruned["dense"] == 0)) >= 32
+
+    opt = FusedSGD(lr=0.5)
+    state = opt.init(pruned)
+    grads = jax.tree_util.tree_map(jnp.ones_like, pruned)
+    step = asp.wrap_step(opt.step, masks)
+    new_params, _ = step(grads, state, pruned)
+    # masked slots stay exactly zero after the update
+    np.testing.assert_array_equal(
+        np.asarray(new_params["dense"] == 0), np.asarray(masks["dense"] == 0))
+    # unmasked slots moved
+    moved = np.asarray(new_params["dense"] != pruned["dense"])
+    assert moved[np.asarray(masks["dense"]) == 1].all()
+
+
+def test_asp_rejects_permutation():
+    with pytest.raises(NotImplementedError):
+        ASP(allow_permutation=True)
+
+
+# --------------------------------------------------------- contrib layer_norm
+
+
+def test_fast_layer_norm_vs_torch():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32)) * 2.0
+    gamma = jax.random.normal(jax.random.PRNGKey(1), (32,)) * 0.1 + 1.0
+    beta = jax.random.normal(jax.random.PRNGKey(2), (32,)) * 0.1
+    y = FastLayerNormFN.apply(x, gamma, beta, 1e-5)
+    ref = torch.nn.functional.layer_norm(
+        torch.tensor(np.asarray(x)), (32,),
+        torch.tensor(np.asarray(gamma)), torch.tensor(np.asarray(beta)), 1e-5)
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_fast_layer_norm_module():
+    m = FastLayerNorm(hidden_size=16, memory_efficient=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 16))
+    v = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(v, x)
+    np.testing.assert_allclose(float(jnp.mean(y)), 0.0, atol=1e-5)
+
+
+# ------------------------------------------------------------- import smoke
+
+
+def test_all_public_names_import():
+    import importlib
+    import apex_tpu
+
+    for name in ("amp", "optimizers", "normalization", "multi_tensor_apply",
+                 *apex_tpu._LAZY_SUBMODULES):
+        assert getattr(apex_tpu, name) is not None
+    contrib = importlib.import_module("apex_tpu.contrib")
+    for sub in ["optimizers", "clip_grad", "focal_loss", "group_norm",
+                "index_mul_2d", "layer_norm", "sparsity", "xentropy"]:
+        importlib.import_module(f"apex_tpu.contrib.{sub}")
+    del contrib
