@@ -257,10 +257,13 @@ def test_create_mask_density_and_rank_dispatch(shape):
     mask = create_mask(w, "m4n2_1d")
     assert mask.shape == w.shape
     np.testing.assert_allclose(float(jnp.mean(mask)), 0.5)
-    # every 4-group along the input-channel direction has exactly 2 kept
-    if len(shape) == 2:
-        groups = np.asarray(mask).reshape(-1, 4)
-        np.testing.assert_array_equal(groups.sum(1), 2)
+    # every 4-group along the input-channel direction (axis 1 for rank>=2,
+    # axis 0 for rank 1) has exactly 2 kept
+    m = np.asarray(mask)
+    if m.ndim >= 2:
+        m = np.moveaxis(m, 1, -1)  # channel dim last
+    groups = m.reshape(-1, 4)
+    np.testing.assert_array_equal(groups.sum(1), 2)
 
 
 def test_asp_workflow_and_wrapped_step():
