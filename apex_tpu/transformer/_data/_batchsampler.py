@@ -17,6 +17,7 @@ are identical.
 Reference: /root/reference/apex/transformer/_data/_batchsampler.py:38-180.
 """
 import abc
+from typing import Optional
 
 import numpy as np
 
@@ -90,9 +91,19 @@ class MegatronPretrainingSampler(_Base):
         self.drop_last = drop_last
 
     def __len__(self):
+        # Parity quirk kept from the reference (`_batchsampler.py:69-70`):
+        # this is the *sample* count, not the number of yielded batches.
+        # Divide by local_minibatch_size * data_parallel_size for batches.
         return self.total_samples
 
-    def get_start_end_idx(self):
+    def get_start_end_idx(self, batch_len: Optional[int] = None):
+        if batch_len is not None and batch_len < self.local_minibatch_times_data_parallel_size:
+            # partial tail (drop_last=False): split the remainder evenly
+            # across ranks (sizes differ by at most 1; empty only when
+            # batch_len < data_parallel_size)
+            start_idx = batch_len * self.data_parallel_rank // self.data_parallel_size
+            end_idx = batch_len * (self.data_parallel_rank + 1) // self.data_parallel_size
+            return start_idx, end_idx
         start_idx = self.data_parallel_rank * self.local_minibatch_size
         end_idx = start_idx + self.local_minibatch_size
         return start_idx, end_idx
@@ -123,7 +134,7 @@ class MegatronPretrainingSampler(_Base):
                 batch = []
 
         if len(batch) > 0 and not self.drop_last:
-            start_idx, end_idx = self.get_start_end_idx()
+            start_idx, end_idx = self.get_start_end_idx(len(batch))
             yield batch[start_idx:end_idx]
 
 
@@ -156,6 +167,12 @@ class MegatronPretrainingRandomSampler(_Base):
                 "data_parallel_rank should be smaller than data parallel size: "
                 f"{data_parallel_rank} < {data_parallel_size}"
             )
+        if total_samples < local_minibatch_size * data_parallel_size:
+            raise ValueError(
+                f"total_samples ({total_samples}) must be at least one global "
+                f"batch ({local_minibatch_size * data_parallel_size}) — no "
+                "complete batch to shuffle"
+            )
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
         self._local_minibatch_size = local_minibatch_size
@@ -169,6 +186,7 @@ class MegatronPretrainingRandomSampler(_Base):
         )
 
     def __len__(self) -> int:
+        # Sample count, not batch count — reference parity (see above).
         return self.total_samples
 
     @property
@@ -177,9 +195,20 @@ class MegatronPretrainingRandomSampler(_Base):
 
     @local_minibatch_size.setter
     def local_minibatch_size(self, new_local_minibatch_size) -> None:
+        if self.total_samples < new_local_minibatch_size * self.data_parallel_size:
+            raise ValueError(
+                f"total_samples ({self.total_samples}) must be at least one "
+                f"global batch "
+                f"({new_local_minibatch_size * self.data_parallel_size})"
+            )
         self._local_minibatch_size = new_local_minibatch_size
         self.local_minibatch_times_data_parallel_size = (
             self._local_minibatch_size * self.data_parallel_size
+        )
+        # epoch/resume math depends on the tail size; keep it in sync after
+        # a batch-size rampup
+        self.last_batch_size = (
+            self.total_samples % self.local_minibatch_times_data_parallel_size
         )
 
     def __iter__(self):

@@ -1,0 +1,180 @@
+"""ResNet family in flax (NHWC, TPU-native layout).
+
+Stands in for the reference example's ``torchvision.models.resnet*``
+(``/root/reference/examples/imagenet/main_amp.py:17,152``). NHWC is the
+layout the TPU MXU consumes natively, so it is the default here (the CUDA
+example reaches the same place via ``--channels-last``).
+
+``norm`` is pluggable so ``--sync_bn`` can swap every BatchNorm for
+``apex_tpu.parallel.SyncBatchNorm`` — the functional analogue of the
+reference's ``apex.parallel.convert_syncbn_model(model)``
+(``main_amp.py:161``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with expansion 4 (resnet50/101/152)."""
+
+    features: int
+    strides: Tuple[int, int]
+    norm: ModuleDef
+    conv: ModuleDef
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.features, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.features, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.features * self.expansion, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.features * self.expansion, (1, 1), self.strides,
+                name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (resnet18/34)."""
+
+    features: int
+    strides: Tuple[int, int]
+    norm: ModuleDef
+    conv: ModuleDef
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.features, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.features, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.features, (1, 1), self.strides, name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    norm: Callable = nn.BatchNorm  # overridable; see build_norm below
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=x.dtype)
+        norm = functools.partial(self.norm, use_running_average=not train)
+
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(
+                    self.num_filters * 2 ** i,
+                    strides=strides,
+                    norm=norm,
+                    conv=conv,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier in fp32 (matches the example's `criterion(output.float())`)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="fc")(x.astype(jnp.float32))
+        return x
+
+
+_ARCHS = {
+    "resnet18": ([2, 2, 2, 2], BasicBlock),
+    "resnet34": ([3, 4, 6, 3], BasicBlock),
+    "resnet50": ([3, 4, 6, 3], Bottleneck),
+    "resnet101": ([3, 4, 23, 3], Bottleneck),
+    "resnet152": ([3, 8, 36, 3], Bottleneck),
+}
+
+
+def model_names():
+    return sorted(_ARCHS)
+
+
+def build_model(arch: str, num_classes: int = 1000, sync_bn: bool = False,
+                bn_axis_name: str = "data") -> ResNet:
+    """Build a ResNet; ``sync_bn=True`` uses apex_tpu SyncBatchNorm over the
+    data-parallel mesh axis (the ``convert_syncbn_model`` path)."""
+    if arch not in _ARCHS:
+        raise ValueError(f"unknown arch {arch!r}; options {model_names()}")
+    stages, block = _ARCHS[arch]
+    if sync_bn:
+        def norm(use_running_average=False, name=None, scale_init=None):
+            # scale_init=zeros is the residual-branch zero-init trick.
+            return _SyncBNShim(axis_name=bn_axis_name,
+                               zero_scale=scale_init is not None,
+                               use_running_average=use_running_average,
+                               name=name)
+    else:
+        def norm(use_running_average=False, name=None, scale_init=None):
+            return nn.BatchNorm(
+                use_running_average=use_running_average,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=jnp.float32,
+                scale_init=scale_init or nn.initializers.ones,
+                name=name,
+            )
+    return ResNet(stage_sizes=stages, block=block, num_classes=num_classes,
+                  norm=norm)
+
+
+class _SyncBNShim(nn.Module):
+    """Adapter: apex_tpu SyncBatchNorm with an optional zero-initialised scale
+    (the residual-branch trick) and flax-BatchNorm-like call signature."""
+
+    axis_name: str = "data"
+    zero_scale: bool = False
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+
+        c = x.shape[-1]
+        init = nn.initializers.zeros if self.zero_scale else nn.initializers.ones
+        scale = self.param("scale", init, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        training = not self.use_running_average and not self.is_initializing()
+        y, new_rm, new_rv = sync_batch_norm(
+            x, scale, bias, ra_mean.value, ra_var.value,
+            training=training, momentum=0.1, eps=1e-5,
+            axis_name=self.axis_name if training else None,
+            channel_last=True,
+        )
+        if training:
+            ra_mean.value = new_rm
+            ra_var.value = new_rv
+        return y

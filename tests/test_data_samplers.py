@@ -73,6 +73,22 @@ class TestMegatronPretrainingSampler:
         )
         assert list(s2)[-1] == [8, 9]
 
+    def test_partial_tail_split_across_ranks(self):
+        # 10 samples, dp=2, local=4: global batch of 8, then tail [8, 9]
+        # which must be split one sample per rank (not rank-sliced to empty)
+        tails = []
+        for rank in range(2):
+            s = MegatronPretrainingSampler(
+                total_samples=10,
+                consumed_samples=0,
+                local_minibatch_size=4,
+                data_parallel_rank=rank,
+                data_parallel_size=2,
+                drop_last=False,
+            )
+            tails.append(list(s)[-1])
+        assert tails == [[8], [9]]
+
     def test_rampup_batch_size_setter(self):
         s = MegatronPretrainingSampler(
             total_samples=32,
@@ -131,6 +147,16 @@ class TestMegatronPretrainingRandomSampler:
         n = len(list(s))
         assert s.consumed_samples == n * 8  # 8 = local*dp consumed per yield
 
+    def test_rampup_recomputes_tail(self):
+        s = self._make(rank=0, total=64, local=4, dp=2)
+        assert s.last_batch_size == 0
+        s.local_minibatch_size = 3
+        assert s.last_batch_size == 64 % 6
+        # resume at end of the (new) epoch still iterates (epoch 1 starts)
+        s2 = self._make(rank=0, total=64, local=4, dp=2, consumed=60)
+        s2.local_minibatch_size = 3
+        assert len(list(s2)) > 0
+
     def test_validation(self):
         with pytest.raises(ValueError):
             MegatronPretrainingRandomSampler(0, 0, 4, 0, 1)
@@ -138,3 +164,6 @@ class TestMegatronPretrainingRandomSampler:
             MegatronPretrainingRandomSampler(8, 0, 0, 0, 1)
         with pytest.raises(ValueError):
             MegatronPretrainingRandomSampler(8, 0, 4, 2, 2)
+        with pytest.raises(ValueError):
+            # less than one global batch: nothing to shuffle
+            MegatronPretrainingRandomSampler(6, 0, 4, 0, 2)
