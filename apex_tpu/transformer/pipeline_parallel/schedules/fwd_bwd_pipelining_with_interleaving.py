@@ -7,14 +7,17 @@ global layer block ``v*pp + s``); the hand-written scheduler interleaves
 microbatches across chunks to shrink the pipeline bubble from
 ``(pp−1)/m`` to ``(pp−1)/(m·vpp)``.
 
-TPU-native: the dataflow — every microbatch traverses the stage ring ``vpp``
-times — is expressed as ``vpp`` pipeline rounds with a last→first ppermute
-hand-off between rounds (``pipeline_rounds`` in the non-interleaved
-module). The *numerics* are identical to the reference's interleaved
-schedule (same chunk composition order); the *overlap* of rounds — the
-bubble-shrinking part — is left to XLA's scheduler over the single traced
-program rather than re-implemented as Python bookkeeping. Backward is JAX
-autodiff through the whole multi-round loop.
+TPU-native: every microbatch traverses the stage ring ``vpp`` times inside
+ONE continuous ``lax.scan`` of ``n·vpp + pp − 1`` ticks
+(``pipeline_rounds`` in the non-interleaved module): each stage picks its
+per-tick chunk by dynamic index into the stacked ``[vpp]`` chunk axis, and
+stage 0 starts group ``g+1`` / chunk ``c+1`` work the very tick the
+previous stream step finishes — there is **no inter-round barrier**, so
+the bubble is ``pp − 1`` ticks total, the reference's
+``(pp−1)/(m·vpp)`` fraction. Numerics are identical to the reference's
+interleaved schedule (same chunk composition order); backward is JAX
+autodiff through the scan (ppermutes transpose to reverse hops).
+Requires ``n_micro % pp == 0`` like the reference.
 """
 from __future__ import annotations
 

@@ -6,17 +6,23 @@ Reference:
 (send_forward_recv_backward / backward / send_backward_recv_forward),
 cooldown drain; hand-written backward_step per microbatch.
 
-TPU-native: the forward pipeline is a ``lax.scan`` over
-``n_micro + pp − 1`` ticks in which every stage applies its chunk and
-``ppermute``s the activation to its successor; stage 0 injects microbatch
-``t``, the last stage emits microbatch ``t − (pp−1)``. The *backward*
+TPU-native: the forward pipeline is ONE ``lax.scan`` over
+``n_micro·vpp + pp − 1`` ticks in which every stage applies its per-tick
+chunk and ``ppermute``s the activation to its successor; stage 0 injects a
+fresh microbatch on its chunk-0 ticks and consumes ring wrap-arounds on the
+rest (see :func:`pipeline_rounds` for the exact schedule). The *backward*
 schedule is not written at all: differentiating the scan transposes every
 ppermute into the reverse hop and replays stages in reverse tick order —
 structurally the same drain the reference's cooldown loop implements. With
-``checkpoint_stages=True`` each stage call is rematerialised in backward,
-bounding live activations to O(in-flight microbatches) — the memory
-property 1F1B buys on CUDA. The warmup/steady/cooldown *phasing* itself is
-XLA's scheduling problem, not Python's.
+``checkpoint_stages=True`` each stage call is rematerialised in backward.
+
+Honest memory note: autodiff through the scan saves the per-tick stage
+*boundary* activations, so live memory is O(n_micro) boundary tensors plus
+(with remat) one stage's internals — not the O(pp) in-flight bound true
+1F1B achieves by interleaving each microbatch's backward into the steady
+state. Fine at the microbatch counts the tests and benches use; a
+re-circulating custom-vjp schedule would be needed to reproduce the exact
+1F1B footprint at very large ``n_micro``.
 
 This function is the *local* (inside-``shard_map``) form so it composes
 with TP/SP/DP axes; ``run_pipeline`` wraps it in a shard_map for the
@@ -37,51 +43,101 @@ Pytree = Any
 
 def pipeline_rounds(
     stage_fn: Callable,
-    stage_params_chunks,  # tuple of per-chunk local params (vpp entries)
+    stage_params_chunks,  # tuple of per-chunk trees, or stacked tree + num_chunks
     inputs: jax.Array,  # [n, ...] microbatched first-stage activations
     axis_name: str,
     checkpoint_stages: bool,
+    num_chunks: Optional[int] = None,
 ) -> jax.Array:
-    """Push all microbatches through ``len(chunks)`` pipeline rounds.
+    """Stream all microbatches through ``vpp = len(chunks)`` traversals of
+    the stage ring in ONE continuous scan of ``n·vpp + pp − 1`` ticks —
+    the interleaved (virtual-pipeline) schedule with no inter-round barrier.
 
-    Round ``r`` runs chunk ``r`` on every stage (virtual pipelining: chunk
-    ``r`` on stage ``s`` holds global layer-block ``r*pp + s``); the last
-    stage's outputs rotate back to stage 0 as the next round's inputs.
-    Returns the last round's outputs ``[n, ...]`` valid on the last stage.
+    Work layout (matches the reference interleaved scheduler,
+    ``fwd_bwd_pipelining_with_interleaving.py:27-744``): microbatches are
+    processed in groups of ``pp``; the item entering stage 0 at tick ``t``
+    is microbatch ``(t // (vpp·pp))·pp + t % pp`` on chunk
+    ``(t // pp) % vpp`` — i.e. group ``g``'s chunk-``c`` pass begins the
+    tick chunk ``c−1``'s first wrap-around arrives, while group ``g+1``
+    starts injecting the tick group ``g`` finishes. Stage 0 is never idle
+    between warmup and drain, so the pipeline bubble is ``pp − 1`` *ticks*
+    (vs ``(pp−1)·vpp`` for the non-interleaved schedule at the same total
+    work): the reference's ``(pp−1)/(m·vpp)`` bubble fraction.
+
+    Every stage selects its per-tick chunk params by dynamic index into the
+    stacked ``[vpp, ...]`` chunk axis (the SPMD spelling of the reference's
+    model-chunk bookkeeping).
+
+    Requires ``n % pp == 0`` when ``vpp > 1`` (the reference asserts the
+    same). Returns the final-chunk outputs ``[n, ...]`` microbatch-ordered,
+    valid on the last stage.
     """
     pp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     n = inputs.shape[0]
+    if isinstance(stage_params_chunks, (tuple, list)):
+        # legacy per-chunk-tuple interface: stack once here
+        vpp = len(stage_params_chunks)
+        if vpp == 1:
+            stacked = stage_params_chunks[0]
+        else:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *stage_params_chunks
+            )
+    else:
+        # already-stacked tree: leaves carry a leading [num_chunks] axis
+        # (none for num_chunks == 1) — no slice/re-stack round-trip
+        if num_chunks is None:
+            raise ValueError("num_chunks required with a stacked params tree")
+        vpp = num_chunks
+        stacked = stage_params_chunks
+    if vpp > 1 and n % pp != 0:
+        raise ValueError(
+            f"interleaved schedule requires n_micro ({n}) divisible by the "
+            f"pipeline size (reference asserts the same)"
+        )
     fwd = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
     perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+    total = n * vpp + pp - 1  # ticks
 
-    def one_round(params_chunk, round_inputs):
-        def body(state, t):
-            idx = jnp.clip(t, 0, n - 1)
-            inject = jax.lax.dynamic_index_in_dim(
-                round_inputs, idx, 0, keepdims=False
+    def body(state, t):
+        # the item this rank processes entered stage 0 at tick u
+        u = jnp.clip(t - rank, 0, n * vpp - 1)
+        c = (u // pp) % vpp  # chunk this rank applies at tick t
+        # stage 0 injects a fresh microbatch on its chunk-0 ticks; on other
+        # ticks it consumes the wrap-around from the last stage
+        inject_now = (t // pp) % vpp == 0
+        m_inj = jnp.clip((t // (vpp * pp)) * pp + t % pp, 0, n - 1)
+        injected = jax.lax.dynamic_index_in_dim(inputs, m_inj, 0, keepdims=False)
+        x = jnp.where((rank == 0) & inject_now, injected, state)
+        if vpp == 1:
+            params_c = stacked
+        else:
+            params_c = jax.tree_util.tree_map(
+                lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+                stacked,
             )
-            x = jnp.where(rank == 0, inject, state)
-            y = fwd(params_chunk, x)
-            new_state = jax.lax.ppermute(y, axis_name, perm_fwd)
-            # the last stage's y at tick t is microbatch t-(pp-1)
-            return new_state, y
+        y = fwd(params_c, x)
+        new_state = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return new_state, y
 
-        init = jnp.zeros_like(inputs[0])
-        # the carry is pipeline-varying (it came through a ppermute); mark
-        # the zeros init accordingly for shard_map's vma tracking
-        if hasattr(jax.lax, "pvary") and axis_name not in init.aval.vma:
-            init = jax.lax.pvary(init, (axis_name,))
-        _, ys = jax.lax.scan(body, init, jnp.arange(n + pp - 1))
-        return ys[pp - 1 :]  # [n, ...] microbatch-ordered, valid on last stage
+    init = jnp.zeros_like(inputs[0])
+    # the carry is pipeline-varying (it came through a ppermute); mark the
+    # zeros init accordingly for shard_map's vma tracking
+    if hasattr(jax.lax, "pvary") and axis_name not in init.aval.vma:
+        init = jax.lax.pvary(init, (axis_name,))
+    _, ys = jax.lax.scan(body, init, jnp.arange(total))
+    # on the last stage, microbatch m = g·pp + i finishes its final chunk at
+    # tick g·vpp·pp + (vpp−1)·pp + i + (pp−1); gather those rows (static idx)
+    import numpy as _np
 
-    outs = inputs
-    for r, chunk in enumerate(stage_params_chunks):
-        if r > 0:
-            # hand the last stage's outputs back to stage 0 for the next round
-            outs = jax.lax.ppermute(outs, axis_name, perm_fwd)
-        outs = one_round(chunk, outs)
-    return outs
+    t_out = _np.array(
+        [
+            (m // pp) * vpp * pp + (vpp - 1) * pp + (m % pp) + pp - 1
+            for m in range(n)
+        ]
+    )
+    return ys[t_out]  # [n, ...] microbatch-ordered, valid on last stage
 
 
 def pipeline_forward_backward(
@@ -127,17 +183,10 @@ def pipeline_forward_backward(
     if extras is None:
         extras = jnp.zeros((n,))
 
-    def chunks_of(params):
-        if num_chunks == 1:
-            return (params,)
-        return tuple(
-            jax.tree_util.tree_map(lambda p: p[i], params)
-            for i in range(num_chunks)
-        )
-
     def local_loss(params, inputs):
         outs = pipeline_rounds(
-            stage_fn, chunks_of(params), inputs, a, checkpoint_stages
+            stage_fn, params, inputs, a, checkpoint_stages,
+            num_chunks=num_chunks,
         )
 
         def per_micro(carry, xs):

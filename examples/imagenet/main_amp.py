@@ -252,6 +252,14 @@ def main(args=None):
     args = args or parse()
     if args.cpu:
         _force_cpu_mesh(args.cpu)
+    if not args.synthetic:
+        raise SystemExit(
+            "a real JPEG input pipeline is not wired up in this port — run "
+            "with --synthetic (the driver benches that mode); passing a data "
+            "directory would otherwise silently train on noise"
+        )
+    if args.data:
+        print(f"note: ignoring data dir {args.data!r} (synthetic mode)")
     print("opt_level =", args.opt_level)
     print("keep_batchnorm_fp32 =", args.keep_batchnorm_fp32)
     print("loss_scale =", args.loss_scale)
@@ -412,6 +420,7 @@ def validate(eval_step, params, batch_stats, batches, args):
     top1 = AverageMeter()
     top5 = AverageMeter()
     end = time.time()
+    last_print = -1
     for i, (x, y) in enumerate(batches):
         loss, prec1, prec5 = eval_step(params, batch_stats,
                                        jnp.asarray(x), jnp.asarray(y))
@@ -419,7 +428,8 @@ def validate(eval_step, params, batch_stats, batches, args):
         top1.update(float(prec1), args.batch_size)
         top5.update(float(prec5), args.batch_size)
         if i % args.print_freq == 0:
-            dt = time.time() - end
+            dt = (time.time() - end) / (i - last_print)
+            last_print = i
             print(f"Test: [{i}]\t"
                   f"Speed {args.batch_size / max(dt, 1e-9):.3f}\t"
                   f"Loss {losses.val:.4f} ({losses.avg:.4f})\t"

@@ -135,6 +135,7 @@ def test_interleaved_matches_sequential(pp_mesh):
     8-block sequential model with the interleaved chunk->layer mapping
     (chunk v on stage s holds global block v*pp + s)."""
     VPP = 2
+    NM = 2 * PP  # the interleaved schedule requires n_micro % pp == 0
     parallel_state.set_virtual_pipeline_model_parallel_world_size(VPP)
     key = jax.random.PRNGKey(5)
     flat = _make_params(key, PP * VPP)  # global blocks 0..7
@@ -145,8 +146,8 @@ def test_interleaved_matches_sequential(pp_mesh):
         )
         for k in flat
     }
-    inputs = jax.random.normal(jax.random.PRNGKey(6), (N_MICRO, MBS, H))
-    targets = jax.random.normal(jax.random.PRNGKey(7), (N_MICRO, MBS, H))
+    inputs = jax.random.normal(jax.random.PRNGKey(6), (NM, MBS, H))
+    targets = jax.random.normal(jax.random.PRNGKey(7), (NM, MBS, H))
 
     loss, grads, _ = run_pipeline_interleaved(
         pp_mesh, _stage_fn, _loss_fn, params, inputs, targets
@@ -282,6 +283,67 @@ def test_get_ltor_masks_and_position_ids():
     assert not bool(am[0, 0, 4, 3])  # same doc, earlier position: visible
     # causal upper triangle masked
     assert bool(am[0, 0, 1, 2])
+
+
+def _collect_scan_lengths(jaxpr, acc):
+    """Recursively collect lax.scan trip counts from a jaxpr."""
+
+    def _sub(v):
+        # ClosedJaxpr has .jaxpr; raw Jaxpr has .eqns
+        if hasattr(v, "jaxpr"):
+            return v.jaxpr
+        if hasattr(v, "eqns"):
+            return v
+        return None
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            acc.append(eqn.params["length"])
+        for v in eqn.params.values():
+            items = v if isinstance(v, (list, tuple)) else (v,)
+            for item in items:
+                sub = _sub(item)
+                if sub is not None:
+                    _collect_scan_lengths(sub, acc)
+    return acc
+
+
+def test_interleaved_is_single_scan_no_round_barrier(pp_mesh):
+    """Structural guarantee of actual interleaving: the whole vpp-round
+    traversal is ONE scan of n*vpp + pp - 1 ticks — round r+1 enters stage 0
+    while round r drains. A barriered implementation would show vpp scans of
+    n + pp - 1 ticks instead."""
+    VPP = 2
+    NM = 2 * PP
+    params = {
+        "w": jnp.zeros((PP, VPP, H, H)),
+        "b": jnp.zeros((PP, VPP, H)),
+    }
+    inputs = jnp.zeros((NM, MBS, H))
+    targets = jnp.zeros((NM, MBS, H))
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, i, t: run_pipeline_interleaved(
+            pp_mesh, _stage_fn, _loss_fn, p, i, t, forward_only=True
+        )
+    )(params, inputs, targets)
+    lengths = _collect_scan_lengths(jaxpr.jaxpr, [])
+    expected = NM * VPP + PP - 1
+    assert expected in lengths, f"no {expected}-tick scan found: {lengths}"
+    assert NM + PP - 1 not in lengths, (
+        f"found a per-round {NM + PP - 1}-tick scan — schedule is barriered"
+    )
+
+
+def test_interleaved_requires_divisible_microbatches(pp_mesh):
+    VPP = 2
+    params = {"w": jnp.zeros((PP, VPP, H, H)), "b": jnp.zeros((PP, VPP, H))}
+    inputs = jnp.zeros((PP + 1, MBS, H))  # not divisible by PP
+    targets = jnp.zeros((PP + 1, MBS, H))
+    with pytest.raises(ValueError, match="divisible"):
+        run_pipeline_interleaved(
+            pp_mesh, _stage_fn, _loss_fn, params, inputs, targets,
+            forward_only=True)
 
 
 def test_model_parallel_grad_scaler():
