@@ -35,8 +35,10 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ... import parallel_state
+from ..utils import pvary_union_like, vma_tracking_active
 
 Pytree = Any
 
@@ -121,17 +123,17 @@ def pipeline_rounds(
         new_state = jax.lax.ppermute(y, axis_name, perm_fwd)
         return new_state, y
 
-    init = jnp.zeros_like(inputs[0])
-    # the carry is pipeline-varying (it came through a ppermute); mark the
-    # zeros init accordingly for shard_map's vma tracking
-    if hasattr(jax.lax, "pvary") and axis_name not in init.aval.vma:
-        init = jax.lax.pvary(init, (axis_name,))
+    # the carry is pipeline-varying (it came through a ppermute), and under a
+    # composed mesh the stage output inherits whatever axes the params or
+    # inputs vary on — mark the zeros init with the union so the scan carry
+    # types close under shard_map's vma tracking
+    init = pvary_union_like(
+        jnp.zeros_like(inputs[0]), (inputs, stacked), (axis_name,)
+    )
     _, ys = jax.lax.scan(body, init, jnp.arange(total))
     # on the last stage, microbatch m = g·pp + i finishes its final chunk at
     # tick g·vpp·pp + (vpp−1)·pp + i + (pp−1); gather those rows (static idx)
-    import numpy as _np
-
-    t_out = _np.array(
+    t_out = np.array(
         [
             (m // pp) * vpp * pp + (vpp - 1) * pp + (m % pp) + pp - 1
             for m in range(n)
@@ -194,7 +196,12 @@ def pipeline_forward_backward(
             l = loss_fn(y, ex)
             return carry + l, None
 
-        total, _ = jax.lax.scan(per_micro, 0.0, (outs, extras))
+        # the accumulated loss inherits every axis the stage outputs or the
+        # loss extras vary on; mark the zero init so the carry types close
+        acc0 = pvary_union_like(
+            jnp.zeros((), jnp.result_type(outs)), (outs, extras), (a,)
+        )
+        total, _ = jax.lax.scan(per_micro, acc0, (outs, extras))
         # only the last stage's outputs are real; mask others to zero so
         # their (garbage) loss neither reports nor back-propagates
         masked = jnp.where(rank == pp - 1, total / n, 0.0)
@@ -209,10 +216,23 @@ def pipeline_forward_backward(
     loss, (grads, dinputs) = jax.value_and_grad(local_loss, argnums=(0, 1))(
         stage_params, inputs
     )
-    # dinputs is nonzero only on stage 0 (the inject path); psum makes the
-    # embedding gradient identical everywhere for chaining outside shard_map
-    dinputs = jax.lax.psum(dinputs, a)
-    return jax.lax.psum(loss, a), grads, dinputs
+
+    # dinputs is nonzero only on stage 0 (the inject path); a psum makes the
+    # embedding gradient identical everywhere for chaining outside shard_map.
+    # Under check_vma=True the transpose already inserted that psum (inputs
+    # are unvarying, so their cotangent comes back unvarying) — psum only the
+    # leaves vma still marks as varying, else we'd scale by pp. With vma
+    # tracking OFF every aval has an empty vma, so fall back to the
+    # unconditional psum (distinguished via the axis_index probe).
+    tracking = vma_tracking_active(a)
+
+    def _sync(g):
+        if tracking and a not in getattr(g.aval, "vma", ()):
+            return g
+        return jax.lax.psum(g, a)
+
+    dinputs = jax.tree_util.tree_map(_sync, dinputs)
+    return _sync(loss), grads, dinputs
 
 
 def run_pipeline(
@@ -254,7 +274,7 @@ def run_pipeline(
 
         return jax.shard_map(
             local_f, mesh=mesh, in_specs=(pspec, P(), P()),
-            out_specs=P(), check_vma=False,
+            out_specs=P(), check_vma=True,
         )(stage_params, inputs, extras)
 
     def local(params, inputs, extras):
@@ -270,5 +290,5 @@ def run_pipeline(
     grads_spec = jax.tree_util.tree_map(lambda _: P(ax), stage_params)
     return jax.shard_map(
         local, mesh=mesh, in_specs=(pspec, P(), P()),
-        out_specs=(P(), grads_spec, P()), check_vma=False,
+        out_specs=(P(), grads_spec, P()), check_vma=True,
     )(stage_params, inputs, extras)

@@ -239,3 +239,110 @@ def get_ltor_masks_and_position_ids(
         attention_mask = attention_mask | ~same_doc
 
     return attention_mask, loss_mask, position_ids
+
+
+def pvary(x: jax.Array, axis_names) -> jax.Array:
+    """Mark ``x`` varying over ``axis_names`` — ``jax.lax.pcast`` on new JAX,
+    falling back to the deprecated ``jax.lax.pvary``; identity where neither
+    exists (pre-vma JAX)."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    if not axis_names:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x
+
+
+def vma_tracking_active(axis_name: str) -> bool:
+    """True when the enclosing shard_map tracks varying-manual-axes
+    (``check_vma=True``). ``axis_index`` is varying over its axis by
+    construction, so an empty vma on it means tracking is off — unlike
+    probing a data value, whose vma is legitimately empty when replicated."""
+    probe = jax.lax.axis_index(axis_name)
+    return axis_name in getattr(probe.aval, "vma", ())
+
+
+def pvary_union_like(init: jax.Array, operands, extra_axes=()) -> jax.Array:
+    """pvary ``init`` with every axis any of ``operands``' leaves vary on,
+    plus ``extra_axes`` — the closure rule for zero-initialised scan carries
+    whose body mixes the operands (carry in/out types must match)."""
+    want = set(extra_axes)
+    for op in operands:
+        for leaf in jax.tree_util.tree_leaves(op):
+            want |= set(getattr(leaf.aval, "vma", ()))
+    missing = tuple(a for a in want if a not in getattr(init.aval, "vma", ()))
+    return pvary(init, missing)
+
+
+def pvary_full(tree: Pytree, axis_names: Sequence[str]) -> Pytree:
+    """Mark every leaf of ``tree`` as varying over all of ``axis_names``.
+
+    The composed-mesh (TP x PP x DP) entry pattern under
+    ``shard_map(check_vma=True)``: marking every operand fully varying makes
+    autodiff produce pure per-device partial gradients with no implicit
+    collectives, so the cross-device gradient structure can be applied
+    explicitly (and auditable) by :func:`sync_grads_by_spec`. This is the
+    library spelling of the grad-sync contract the reference distributes
+    across DDP hooks (``apex/parallel/distributed.py:323-412``) and the TP
+    linears' backward all-reduces (``tensor_parallel/layers.py:279-437``).
+    """
+    def leaf(x):
+        missing = tuple(
+            a for a in axis_names if a not in getattr(x.aval, "vma", ())
+        )
+        return pvary(x, missing) if missing else x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def sync_grads_by_spec(grads: Pytree, pspec: Pytree, axis_names: Sequence[str]) -> Pytree:
+    """psum each gradient leaf over every mesh axis its parameter is NOT
+    sharded on.
+
+    ``pspec`` mirrors ``grads``' structure with a ``PartitionSpec`` per leaf
+    (the parameter shardings). A parameter sharded on an axis has distinct
+    per-shard gradients (no sync); a parameter replicated over an axis
+    accumulated per-device partials there that must be summed — data-parallel
+    sync over ``data``, replicated-weight sync over ``tensor``/``pipeline``.
+    Use with :func:`pvary_full` on the inputs of the gradient computation.
+    """
+
+    def sync(g, spec):
+        sharded = set()
+        for part in spec:
+            if part is None:
+                continue
+            if isinstance(part, str):
+                sharded.add(part)
+            else:
+                sharded.update(part)
+        unsynced = tuple(a for a in axis_names if a not in sharded)
+        return jax.lax.psum(g, unsynced) if unsynced else g
+
+    return jax.tree_util.tree_map(sync, grads, pspec)
+
+
+def mask_to_axis_root(value: jax.Array, axis_names) -> jax.Array:
+    """Zero ``value`` on every rank except index 0 of each axis in
+    ``axis_names``.
+
+    Companion to :func:`pvary_full`/:func:`sync_grads_by_spec`: a loss that
+    is *replicated* over an axis (e.g. tensor-parallel ranks after an output
+    gather, or vocab-parallel CE after its psums) must seed its cotangent
+    exactly once per replica group, otherwise the collective transposes in
+    the backward (psum / psum_scatter inside the TP mappings) sum the
+    duplicate seeds and every gradient comes out scaled by the axis size.
+    Mask the loss with this before differentiating, then undo the mask on
+    the *value* with ``jax.lax.psum(loss, axis)``. (The pipeline schedules
+    already apply the same masking over the pipeline axis — non-last stages
+    contribute zero.)
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    keep = jnp.bool_(True)
+    for a in axis_names:
+        keep = keep & (jax.lax.axis_index(a) == 0)
+    return jnp.where(keep, value, jnp.zeros_like(value))

@@ -335,6 +335,40 @@ def test_interleaved_is_single_scan_no_round_barrier(pp_mesh):
     )
 
 
+def test_local_form_works_without_vma_tracking(pp_mesh):
+    """pipeline_forward_backward is exported for embedding in user shard_maps,
+    including check_vma=False ones where every aval has an empty vma — the
+    loss/dinputs pipeline psum must still run there (regression: the
+    vma-conditional sync must not silently skip it)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pipeline_forward_backward,
+    )
+
+    params = _make_params(jax.random.PRNGKey(11), PP)
+    inputs = jax.random.normal(jax.random.PRNGKey(12), (N_MICRO, MBS, H))
+    targets = jax.random.normal(jax.random.PRNGKey(13), (N_MICRO, MBS, H))
+    pspec = jax.tree_util.tree_map(lambda _: P("pipeline"), params)
+
+    def local(p, i, t):
+        p = jax.tree_util.tree_map(lambda x: x[0], p)
+        loss, grads, dinp = pipeline_forward_backward(
+            _stage_fn, _loss_fn, p, i, t, axis_name="pipeline"
+        )
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads), dinp
+
+    loss, grads, dinp = jax.shard_map(
+        local, mesh=pp_mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec, P()), check_vma=False,
+    )(params, inputs, targets)
+
+    ref_loss, ref_grads = _sequential_reference(params, inputs, targets, PP)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]), np.asarray(ref_grads["w"]), atol=1e-5)
+    ref_dinp = jax.grad(lambda inp: _seq_loss(params, inp, targets))(inputs)
+    np.testing.assert_allclose(np.asarray(dinp), np.asarray(ref_dinp), atol=1e-5)
+
+
 def test_interleaved_requires_divisible_microbatches(pp_mesh):
     VPP = 2
     params = {"w": jnp.zeros((PP, VPP, H, H)), "b": jnp.zeros((PP, VPP, H))}
