@@ -191,17 +191,15 @@ def pipeline_forward_backward(
             num_chunks=num_chunks,
         )
 
+        # emit per-microbatch losses and sum after — no carry, so neither
+        # the loss dtype (may differ from the stage-output dtype in mixed
+        # precision) nor its vma set needs pre-declaring
         def per_micro(carry, xs):
             y, ex = xs
-            l = loss_fn(y, ex)
-            return carry + l, None
+            return carry, loss_fn(y, ex)
 
-        # the accumulated loss inherits every axis the stage outputs or the
-        # loss extras vary on; mark the zero init so the carry types close
-        acc0 = pvary_union_like(
-            jnp.zeros((), jnp.result_type(outs)), (outs, extras), (a,)
-        )
-        total, _ = jax.lax.scan(per_micro, acc0, (outs, extras))
+        _, per_losses = jax.lax.scan(per_micro, None, (outs, extras))
+        total = jnp.sum(per_losses)
         # only the last stage's outputs are real; mask others to zero so
         # their (garbage) loss neither reports nor back-propagates
         masked = jnp.where(rank == pp - 1, total / n, 0.0)

@@ -27,6 +27,12 @@ from .layers import (  # noqa: F401
     row_parallel_linear,
     vocab_parallel_embedding,
 )
+from .grad_accumulation import (  # noqa: F401
+    accumulate_main_grads,
+    init_main_grads,
+    wgrad_gemm_accum_fp16,
+    wgrad_gemm_accum_fp32,
+)
 from .utils import (  # noqa: F401
     VocabUtility,
     divide,

@@ -1,0 +1,122 @@
+"""fp32 main_grad accumulation — gradient-accumulation fusion for TP linears.
+
+Reference: ``csrc/megatron/fused_weight_gradient_dense*`` (exposed as
+``fused_weight_gradient_mlp_cuda.wgrad_gemm_accum_fp32/fp16``) consumed by
+``apex/transformer/tensor_parallel/layers.py:415-424``: the weight-gradient
+GEMM writes **into a persistent fp32 ``main_grad`` buffer** with ``beta=1``
+accumulation, so a gradient-accumulation loop over microbatches never
+materialises per-microbatch weight grads in model dtype — bf16/fp16 compute,
+fp32 accumulate.
+
+TPU-native: two layers of the same contract.
+
+- :func:`wgrad_gemm_accum_fp32` / ``fp16`` — the kernel-level API:
+  one dW = dYᵀ·X GEMM with fp32 (MXU-native) accumulation added into the
+  running buffer. XLA fuses the add into the GEMM epilogue.
+- :func:`accumulate_main_grads` — the loop-level contract: a ``lax.scan``
+  over microbatches carrying the fp32 grad tree; each tick's (bf16) grads
+  are cast and added into the carry and are dead before the next tick, so
+  peak memory holds ONE microbatch's grads + the fp32 buffer — the same
+  footprint the reference achieves with ``param.main_grad`` hooks.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def wgrad_gemm_accum_fp32(
+    total_input: jax.Array, grad_output: jax.Array, main_grad: jax.Array
+) -> jax.Array:
+    """``main_grad += grad_outputᵀ @ total_input`` in fp32.
+
+    Parity with ``fused_weight_gradient_mlp_cuda.wgrad_gemm_accum_fp32``
+    (``csrc/megatron/fused_weight_gradient_dense.cpp``): ``total_input``
+    is ``[..., in]``, ``grad_output`` ``[..., out]`` (matching leading
+    dims, e.g. ``[s, b]``), ``main_grad`` ``[out, in]`` fp32. Inputs may be
+    bf16/fp16; the GEMM accumulates in fp32 on the MXU
+    (``preferred_element_type``) and the += fuses into its epilogue.
+    Returns the updated buffer (functional in-place: donate/carry it).
+    """
+    if main_grad.dtype != jnp.float32:
+        # the reference dispatches on main_grad.dtype and raises on mismatch
+        # (tensor_parallel/layers.py:415-427); silent promotion would change
+        # the buffer dtype mid-loop
+        raise ValueError(
+            f"wgrad_gemm_accum_fp32 requires an fp32 main_grad buffer, got "
+            f"{main_grad.dtype} (use wgrad_gemm_accum_fp16 for half buffers)"
+        )
+    x = total_input.reshape(-1, total_input.shape[-1])
+    dy = grad_output.reshape(-1, grad_output.shape[-1])
+    dw = jnp.einsum(
+        "ko,ki->oi", dy, x, preferred_element_type=jnp.float32
+    )
+    return main_grad + dw
+
+
+def wgrad_gemm_accum_fp16(
+    total_input: jax.Array, grad_output: jax.Array, main_grad: jax.Array
+) -> jax.Array:
+    """Half-precision-buffer variant (``_16bit_prec_cuda.cu``): the GEMM
+    still accumulates fp32 internally, the buffer stays in its own dtype."""
+    x = total_input.reshape(-1, total_input.shape[-1])
+    dy = grad_output.reshape(-1, grad_output.shape[-1])
+    dw = jnp.einsum("ko,ki->oi", dy, x, preferred_element_type=jnp.float32)
+    return (main_grad.astype(jnp.float32) + dw).astype(main_grad.dtype)
+
+
+def init_main_grads(params: Pytree) -> Pytree:
+    """fp32 zero buffers shaped like ``params`` — the ``param.main_grad``
+    allocation of the reference's DDP/optimizer setup."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+
+def accumulate_main_grads(
+    grad_fn: Callable,
+    params: Pytree,
+    microbatches: Pytree,
+    main_grads: Optional[Pytree] = None,
+) -> Pytree:
+    """Accumulate ``grad_fn(params, microbatch)`` over the leading microbatch
+    axis into fp32 ``main_grads`` without materialising per-microbatch grads.
+
+    ``grad_fn(params, micro) -> grad_tree`` computes one microbatch's grads
+    (any dtype; typically bf16 from a bf16 model). The scan carry is the
+    fp32 buffer tree; each tick's grads are consumed by the += immediately,
+    so only one microbatch's grads are ever live. This is the contract of
+    the reference's gradient-accumulation fusion
+    (``tensor_parallel/layers.py:415-424``): fp32 accumulation across
+    microbatches with bf16 compute.
+
+    Pass ``main_grads`` to continue an existing accumulation (e.g. across
+    gradient-accumulation boundaries); defaults to zeros.
+    """
+    if main_grads is None:
+        main_grads = init_main_grads(params)
+    else:
+        bad = [
+            l.dtype
+            for l in jax.tree_util.tree_leaves(main_grads)
+            if l.dtype != jnp.float32
+        ]
+        if bad:
+            raise ValueError(
+                f"main_grads must be fp32 buffers (got {bad[0]}); the fp32 "
+                "accumulation across microbatches is the point of this API"
+            )
+
+    def tick(acc, micro):
+        g = grad_fn(params, micro)
+        acc = jax.tree_util.tree_map(
+            lambda a, gi: a + gi.astype(jnp.float32), acc, g
+        )
+        return acc, None
+
+    out, _ = jax.lax.scan(tick, main_grads, microbatches)
+    return out

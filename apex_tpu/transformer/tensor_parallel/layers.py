@@ -18,6 +18,11 @@ runs inside ``shard_map`` over the ``tensor`` mesh axis: weights are
 per-device shards, ``[out/tp, in]`` for column, ``[out, in/tp]`` for row,
 ``[vocab/tp, hidden]`` for the embedding.
 
+The fp32 ``main_grad`` accumulation contract itself (wgrad GEMM accumulating
+into a persistent fp32 buffer across microbatches) lives in
+``grad_accumulation.py``: ``wgrad_gemm_accum_fp32/fp16`` +
+``accumulate_main_grads`` — use those for gradient-accumulation loops.
+
 Both a functional core (pure functions over explicit shards) and flax
 modules (per-shard params with rank-folded init, the moral equivalent of the
 reference's ``_initialize_affine_weight_gpu`` per-partition init ``:110-171``)

@@ -369,6 +369,30 @@ def test_local_form_works_without_vma_tracking(pp_mesh):
     np.testing.assert_allclose(np.asarray(dinp), np.asarray(ref_dinp), atol=1e-5)
 
 
+def test_pipeline_mixed_precision_loss_dtype(pp_mesh):
+    """bf16 stage outputs with an fp32 loss_fn — the canonical mixed
+    precision setup — must work (regression: the loss accumulator's dtype
+    was once pinned to the stage-output dtype)."""
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), _make_params(jax.random.PRNGKey(20), PP)
+    )
+    inputs = jax.random.normal(
+        jax.random.PRNGKey(21), (N_MICRO, MBS, H)
+    ).astype(jnp.bfloat16)
+    targets = jax.random.normal(jax.random.PRNGKey(22), (N_MICRO, MBS, H))
+
+    def f32_loss(y, tgt):
+        return jnp.mean((y.astype(jnp.float32) - tgt) ** 2)
+
+    loss, grads, _ = run_pipeline(
+        pp_mesh, _stage_fn, f32_loss, params, inputs, targets
+    )
+    assert loss.dtype == jnp.float32
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g, np.float32)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
 def test_interleaved_requires_divisible_microbatches(pp_mesh):
     VPP = 2
     params = {"w": jnp.zeros((PP, VPP, H, H)), "b": jnp.zeros((PP, VPP, H))}
