@@ -16,15 +16,25 @@ the saved logsumexp (the flash-attention-2 scheme): one kernel accumulates
 dq over key blocks, a second accumulates dk/dv over query blocks, with
 ``delta = rowsum(dO * O)`` precomputed in XLA.
 
-Layouts: ``[b, n, s, d]`` (canonical) via :func:`flash_attention`, and the
-Megatron ``[s, b, n, d]`` convenience wrapper :func:`flash_attention_sbhd`
-used by ``transformer/testing/standalone_transformer_lm.py``.
+Layouts: ``[b, n, s, d]`` (canonical) via :func:`flash_attention`, the
+Megatron ``[s, b, n, d]`` wrapper :func:`flash_attention_sbhd`, and the
+packed-varlen layout ``[total, n, d]`` + ``cu_seqlens`` via
+:func:`flash_attention_varlen` (the reference fmha's primary mode,
+``contrib/fmha/fmha.py:33-92``) — implemented with per-token segment ids so
+tokens only attend within their own sequence.
 
 Supports: causal masking (block-skipped: tiles strictly above the diagonal
 are neither loaded nor computed), a key-padding mask ``[b, s_k]`` (True =
-attend), softmax scale. Dropout is applied by callers outside the kernel
-(the XLA path); kernel-internal Philox dropout as in the reference fmha is
-not implemented.
+attend), softmax scale, and **in-kernel attention dropout**: the keep mask
+is a counter-based hash of ``(seed, head, global_q, global_k)`` computed in
+plain vector ops inside each tile — the Philox analogue of the reference
+``fmha``/``multihead_attn`` kernels — so the forward never materialises the
+[s, s] probability tensor and the backward regenerates bit-identical masks
+from the same counters (block-size independent, interpret-mode exact).
+
+Fully-masked rows (a key-padding mask removing every key) output zeros with
+``lse = -inf`` — NOT the uniform average a plain XLA softmax would produce
+from an all ``-inf`` row; :func:`mha_reference` pins the same convention.
 """
 from __future__ import annotations
 
@@ -68,14 +78,107 @@ def flash_attention_available(
 
 
 # ---------------------------------------------------------------------------
+# in-tile dropout mask: counter-based hash (murmur3 finalizer), keyed on
+# (seed, batch*heads+head, global_q_index, global_k_index) — identical
+# between forward and backward and independent of block sizes
+# ---------------------------------------------------------------------------
+
+
+def _i32(v):
+    # constants given as unsigned patterns, reinterpreted int32 (wrapping
+    # multiply has the same low-32 bits either way)
+    return jnp.int32(v - 0x100000000 if v >= 0x80000000 else v)
+
+
+def _shr_logical(x, n):
+    return jax.lax.shift_right_logical(x, jnp.int32(n))
+
+
+def _hash_keep_bits(seed, bh, qi, ki):
+    """32-bit hash per (q, k) element, computed entirely in int32 with
+    explicit logical shifts — Mosaic and the interpreter agree on these
+    (uint32 shifts do not lower identically on TPU). ``qi``/``ki`` are
+    int32 tiles of GLOBAL indices; ``seed`` an int32 scalar; ``bh`` the
+    flattened batch-head index."""
+    x = qi * _i32(0x9E3779B1)
+    x = x ^ (ki * _i32(0x85EBCA77))
+    x = x ^ (seed.astype(jnp.int32) + bh.astype(jnp.int32) * _i32(0x27D4EB2F))
+    # murmur3 fmix32
+    x = x ^ _shr_logical(x, 16)
+    x = x * _i32(0x85EBCA6B)
+    x = x ^ _shr_logical(x, 13)
+    x = x * _i32(0xC2B2AE35)
+    x = x ^ _shr_logical(x, 16)
+    return x
+
+
+def _keep_mask(seed, bh, qi, ki, dropout_p):
+    """float32 {0,1} keep mask: P(drop) = dropout_p (unsigned compare of the
+    hash bits against p·2^32, via the sign-flip trick)."""
+    t = int(round(dropout_p * 4294967296.0)) & 0xFFFFFFFF
+    # unsigned(a) >= unsigned(b)  <=>  (a ^ 0x80000000) >= (b ^ 0x80000000)
+    thresh_flipped = _i32(t ^ 0x80000000)
+    bits = _hash_keep_bits(seed, bh, qi, ki) ^ _i32(0x80000000)
+    return (bits >= thresh_flipped).astype(jnp.float32)
+
+
+def dropout_mask_reference(seed: int, b: int, n: int, s_q: int, s_k: int,
+                           dropout_p: float) -> jax.Array:
+    """The exact keep mask the kernels use, materialised (tests only)."""
+    qi = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+    seed = jnp.int32(seed)
+    masks = []
+    for ib in range(b):
+        row = []
+        for ih in range(n):
+            bh = jnp.int32(ib * n + ih)
+            row.append(_keep_mask(seed, bh, qi, ki, dropout_p))
+        masks.append(jnp.stack(row))
+    return jnp.stack(masks)  # [b, n, s_q, s_k]
+
+
+# ---------------------------------------------------------------------------
+# shared tile masking
+# ---------------------------------------------------------------------------
+
+
+def _tile_indices(iq, ik, block_q, block_k):
+    qi = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    ki = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return qi, ki
+
+
+def _mask_scores(s, qi, ki, *, causal, have_mask, mask_ref, have_segs,
+                 segq_ref, segk_ref):
+    if causal:
+        s = jnp.where(ki > qi, _NEG_INF, s)
+    if have_mask:
+        keep = mask_ref[0] != 0  # [1, bk]
+        s = jnp.where(keep, s, _NEG_INF)
+    if have_segs:
+        seg_q = segq_ref[0, 0][:, None]  # [bq, 1]
+        seg_k = segk_ref[0, 0][None, :]  # [1, bk]
+        s = jnp.where(seg_q == seg_k, s, _NEG_INF)
+    return s
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k, n_k, have_mask,
+    q_ref, k_ref, v_ref, mask_ref, segq_ref, segk_ref, seed_ref,
+    o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k, n_k, n_heads, have_mask, have_segs,
+    dropout_p,
 ):
+    ib, ih = pl.program_id(0), pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -92,17 +195,11 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
 
-        if causal:
-            qi = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            ki = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(ki > qi, _NEG_INF, s)
-        if have_mask:
-            keep = mask_ref[0] != 0  # [1, bk]
-            s = jnp.where(keep, s, _NEG_INF)
+        qi, ki = _tile_indices(iq, ik, block_q, block_k)
+        s = _mask_scores(
+            s, qi, ki, causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            have_segs=have_segs, segq_ref=segq_ref, segk_ref=segk_ref,
+        )
 
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -113,9 +210,17 @@ def _fwd_kernel(
         alpha = jnp.exp(m_prev - m_new)
         alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
 
+        # softmax normalizer uses the UNDROPPED probabilities; dropout hits
+        # only the value accumulation (standard attention-dropout semantics:
+        # out = dropout(softmax(s)) @ v)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        p_acc = p
+        if dropout_p > 0.0:
+            bh = ib * n_heads + ih
+            keep = _keep_mask(seed_ref[0], bh, qi, ki, dropout_p)
+            p_acc = p * keep * (1.0 / (1.0 - dropout_p))
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0],
+            p_acc.astype(v_ref.dtype), v_ref[0, 0],
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -139,8 +244,23 @@ def _fwd_kernel(
         lse_ref[0, 0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(safe_l))
 
 
+def _seg_args(segments, s):
+    """(array, have) for an optional [s] / [b, s] int32 segment-id input
+    (a dummy [1, 1, 8] array when absent — its BlockSpec pins block 0)."""
+    have = segments is not None
+    if have:
+        arr = segments.astype(jnp.int32)
+        if arr.ndim == 1:
+            arr = arr[None]
+        arr = arr.reshape(arr.shape[0], 1, s)
+    else:
+        arr = jnp.zeros((1, 1, 8), jnp.int32)
+    return arr, have
+
+
 def _fwd(
-    q, k, v, kv_mask, scale, causal, block_q, block_k, interpret
+    q, k, v, kv_mask, seg_q, seg_k, seed, scale, causal, dropout_p,
+    block_q, block_k, interpret,
 ):
     b, n, s_q, d = q.shape
     s_k = k.shape[2]
@@ -158,11 +278,27 @@ def _fwd(
         (1, 1, bk if have_mask else 8),
         (lambda ib, ih, iq, ik: (ib, 0, ik if have_mask else 0)),
     )
+    if (seg_q is None) != (seg_k is None):
+        raise ValueError("seg_q and seg_k must be provided together")
+    segq_arg, have_segs = _seg_args(seg_q, s_q)
+    segk_arg, _ = _seg_args(seg_k, s_k)
+    segq_spec = pl.BlockSpec(
+        (1, 1, bq if have_segs else 8),
+        (lambda ib, ih, iq, ik: (ib if have_segs and segq_arg.shape[0] > 1 else 0,
+                                 0, iq if have_segs else 0)),
+    )
+    segk_spec = pl.BlockSpec(
+        (1, 1, bk if have_segs else 8),
+        (lambda ib, ih, iq, ik: (ib if have_segs and segk_arg.shape[0] > 1 else 0,
+                                 0, ik if have_segs else 0)),
+    )
+    seed_arg = jnp.asarray([seed if seed is not None else 0], jnp.int32)
 
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
-        have_mask=have_mask,
+        n_heads=n, have_mask=have_mask, have_segs=have_segs,
+        dropout_p=dropout_p,
     )
     grid = (b, n, n_q, n_k)
     out_shape = [
@@ -182,6 +318,9 @@ def _fwd(
             pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
             mask_spec,
+            segq_spec,
+            segk_spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -193,7 +332,7 @@ def _fwd(
         scratch_shapes=scratch,
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, mask_arg)
+    )(q, k, v, mask_arg, segq_arg, segk_arg, seed_arg)
     return o, lse[..., 0]  # lse [b, n, s_q]
 
 
@@ -211,10 +350,12 @@ def _compiler_params():
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dq_ref,
-    acc_scr,
-    *, scale, causal, block_q, block_k, n_k, have_mask,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+    segq_ref, segk_ref, seed_ref, dq_ref, acc_scr,
+    *, scale, causal, block_q, block_k, n_k, n_heads, have_mask, have_segs,
+    dropout_p,
 ):
+    ib, ih = pl.program_id(0), pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -228,17 +369,11 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
-            qi = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            ki = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(ki > qi, _NEG_INF, s)
-        if have_mask:
-            keep = mask_ref[0] != 0
-            s = jnp.where(keep, s, _NEG_INF)
+        qi, ki = _tile_indices(iq, ik, block_q, block_k)
+        s = _mask_scores(
+            s, qi, ki, causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            have_segs=have_segs, segq_ref=segq_ref, segk_ref=segk_ref,
+        )
         lse = lse_ref[0, 0][:, :1]  # [bq, 1]
         p = jnp.exp(s - lse)
         p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
@@ -248,6 +383,10 @@ def _bwd_dq_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_p > 0.0:
+            bh = ib * n_heads + ih
+            keep = _keep_mask(seed_ref[0], bh, qi, ki, dropout_p)
+            dp = dp * keep * (1.0 / (1.0 - dropout_p))
         delta = delta_ref[0, 0][:, :1]
         ds = p * (dp - delta)
         acc_scr[:] += jax.lax.dot_general(
@@ -270,9 +409,11 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-    dk_ref, dv_ref, dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, n_q, have_mask,
+    segq_ref, segk_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale, causal, block_q, block_k, n_q, n_heads, have_mask, have_segs,
+    dropout_p,
 ):
+    ib, ih = pl.program_id(0), pl.program_id(1)
     ik, iq = pl.program_id(2), pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -287,24 +428,26 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
-        if causal:
-            qi = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            ki = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(ki > qi, _NEG_INF, s)
-        if have_mask:
-            keep = mask_ref[0] != 0
-            s = jnp.where(keep, s, _NEG_INF)
+        qi, ki = _tile_indices(iq, ik, block_q, block_k)
+        s = _mask_scores(
+            s, qi, ki, causal=causal, have_mask=have_mask, mask_ref=mask_ref,
+            have_segs=have_segs, segq_ref=segq_ref, segk_ref=segk_ref,
+        )
         lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(s - lse)
         p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         do = do_ref[0, 0].astype(jnp.float32)
-        # dv += p.T @ do
+        if dropout_p > 0.0:
+            bh = ib * n_heads + ih
+            keep = _keep_mask(seed_ref[0], bh, qi, ki, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_d = p * keep * inv
+        else:
+            keep = None
+            p_d = p
+        # dv += p_d.T @ do
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_d, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
@@ -312,6 +455,8 @@ def _bwd_dkv_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if keep is not None:
+            dp = dp * keep * (1.0 / (1.0 - dropout_p))
         delta = delta_ref[0, 0][:, :1]
         ds = p * (dp - delta)  # [bq, bk]
         # dk += ds.T @ q * scale
@@ -334,7 +479,8 @@ def _bwd_dkv_kernel(
 
 
 def _bwd(
-    q, k, v, kv_mask, o, lse, do, scale, causal, block_q, block_k, interpret
+    q, k, v, kv_mask, seg_q, seg_k, seed, o, lse, do, scale, causal,
+    dropout_p, block_q, block_k, interpret,
 ):
     b, n, s_q, d = q.shape
     s_k = k.shape[2]
@@ -356,6 +502,11 @@ def _bwd(
         if have_mask
         else jnp.zeros((b, 1, 8), jnp.int8)
     )
+    if (seg_q is None) != (seg_k is None):
+        raise ValueError("seg_q and seg_k must be provided together")
+    segq_arg, have_segs = _seg_args(seg_q, s_q)
+    segk_arg, _ = _seg_args(seg_k, s_k)
+    seed_arg = jnp.asarray([seed if seed is not None else 0], jnp.int32)
 
     def mask_spec(kmajor):
         if have_mask:
@@ -364,6 +515,29 @@ def _bwd(
             return pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, ik: (ib, 0, ik))
         return pl.BlockSpec((1, 1, 8), lambda ib, ih, i2, i3: (ib, 0, 0))
 
+    def segq_spec(kmajor):
+        nb = segq_arg.shape[0]
+        if have_segs:
+            if kmajor:
+                return pl.BlockSpec(
+                    (1, 1, bq),
+                    lambda ib, ih, ik, iq: (ib if nb > 1 else 0, 0, iq))
+            return pl.BlockSpec(
+                (1, 1, bq), lambda ib, ih, iq, ik: (ib if nb > 1 else 0, 0, iq))
+        return pl.BlockSpec((1, 1, 8), lambda ib, ih, i2, i3: (0, 0, 0))
+
+    def segk_spec(kmajor):
+        nb = segk_arg.shape[0]
+        if have_segs:
+            if kmajor:
+                return pl.BlockSpec(
+                    (1, 1, bk),
+                    lambda ib, ih, ik, iq: (ib if nb > 1 else 0, 0, ik))
+            return pl.BlockSpec(
+                (1, 1, bk), lambda ib, ih, iq, ik: (ib if nb > 1 else 0, 0, ik))
+        return pl.BlockSpec((1, 1, 8), lambda ib, ih, i2, i3: (0, 0, 0))
+
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     q_spec = lambda im: pl.BlockSpec((1, 1, bq, d), im)
     k_spec = lambda im: pl.BlockSpec((1, 1, bk, d), im)
     row_spec = lambda im: pl.BlockSpec((1, 1, bq, 1), im)
@@ -372,7 +546,8 @@ def _bwd(
         functools.partial(
             _bwd_dq_kernel,
             scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
-            have_mask=have_mask,
+            n_heads=n, have_mask=have_mask, have_segs=have_segs,
+            dropout_p=dropout_p,
         ),
         grid=(b, n, n_q, n_k),
         in_specs=[
@@ -383,19 +558,23 @@ def _bwd(
             row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             mask_spec(False),
+            segq_spec(False),
+            segk_spec(False),
+            seed_spec,
         ],
         out_specs=q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, mask_arg)
+    )(q, k, v, do, lse_b, delta_b, mask_arg, segq_arg, segk_arg, seed_arg)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
             scale=scale, causal=causal, block_q=bq, block_k=bk, n_q=n_q,
-            have_mask=have_mask,
+            n_heads=n, have_mask=have_mask, have_segs=have_segs,
+            dropout_p=dropout_p,
         ),
         grid=(b, n, n_k, n_q),
         in_specs=[
@@ -406,6 +585,9 @@ def _bwd(
             row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             mask_spec(True),
+            segq_spec(True),
+            segk_spec(True),
+            seed_spec,
         ],
         out_specs=[
             k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
@@ -421,7 +603,7 @@ def _bwd(
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, mask_arg)
+    )(q, k, v, do, lse_b, delta_b, mask_arg, segq_arg, segk_arg, seed_arg)
     return dq, dk, dv
 
 
@@ -431,30 +613,51 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11)
 )
-def _flash(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret)
+def _flash(q, k, v, kv_mask, segs, seed, scale, causal, dropout_p, block_q,
+           block_k, interpret):
+    seg_q, seg_k = segs if segs is not None else (None, None)
+    o, _ = _fwd(q, k, v, kv_mask, seg_q, seg_k, seed, scale, causal,
+                dropout_p, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, kv_mask, segs, seed, scale, causal, dropout_p,
+               block_q, block_k, interpret):
+    seg_q, seg_k = segs if segs is not None else (None, None)
     o, lse = _fwd(
-        q, k, v, kv_mask, scale, causal, block_q, block_k, interpret
+        q, k, v, kv_mask, seg_q, seg_k, seed, scale, causal, dropout_p,
+        block_q, block_k, interpret,
     )
-    return o, (q, k, v, kv_mask, o, lse)
+    return o, (q, k, v, kv_mask, segs, seed, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, kv_mask, o, lse = res
+def _flash_bwd(scale, causal, dropout_p, block_q, block_k, interpret, res, do):
+    q, k, v, kv_mask, segs, seed, o, lse = res
+    seg_q, seg_k = segs if segs is not None else (None, None)
     dq, dk, dv = _bwd(
-        q, k, v, kv_mask, o, lse, do, scale, causal, block_q, block_k,
-        interpret,
+        q, k, v, kv_mask, seg_q, seg_k, seed, o, lse, do, scale, causal,
+        dropout_p, block_q, block_k, interpret,
     )
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _resolve_seed(dropout_p, dropout_seed):
+    if not 0.0 <= dropout_p < 1.0:
+        # out-of-range p would wrap the 32-bit keep threshold silently
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if dropout_p == 0.0:
+        return None
+    if dropout_seed is None:
+        raise ValueError(
+            "dropout_p > 0 requires dropout_seed (an int or int32 scalar; "
+            "derive a fresh one per step, e.g. from jax.random.randint)"
+        )
+    return jnp.asarray(dropout_seed, jnp.int32)
 
 
 def flash_attention(
@@ -465,27 +668,31 @@ def flash_attention(
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,  # [b, s_k]; True/nonzero = attend
     scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,  # int or int32 scalar; required when dropout_p > 0
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Tiled online-softmax attention, O(s) memory per row block.
 
-    Returns ``softmax(q @ k.T * scale [masked]) @ v`` in ``q.dtype``
-    without materialising the score tensor. Differentiable (custom VJP
-    recomputes score tiles from the saved logsumexp).
+    Returns ``dropout(softmax(q @ k.T * scale [masked])) @ v`` in
+    ``q.dtype`` without materialising the score tensor. Differentiable
+    (custom VJP recomputes score tiles from the saved logsumexp; the
+    dropout mask is regenerated in-kernel from the same hash counters).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if kv_mask is not None:
         kv_mask = kv_mask.astype(jnp.int8)
+    seed = _resolve_seed(dropout_p, dropout_seed)
     # off-TPU the kernel runs in the Pallas interpreter (tests exercise the
     # same code path the TPU compiles)
     if not interpret and jax.default_backend() != "tpu":
         interpret = True
     return _flash(
-        q, k, v, kv_mask, float(scale), bool(causal),
-        int(block_q), int(block_k), bool(interpret),
+        q, k, v, kv_mask, None, seed, float(scale), bool(causal),
+        float(dropout_p), int(block_q), int(block_k), bool(interpret),
     )
 
 
@@ -503,10 +710,63 @@ def flash_attention_sbhd(
     return jnp.transpose(o, (2, 0, 1, 3))
 
 
-def mha_reference(
-    q, k, v, *, causal=False, kv_mask=None, scale=None
+def segment_ids_from_cu_seqlens(cu_seqlens: jax.Array, total: int) -> jax.Array:
+    """[total] int32 segment ids from ``cu_seqlens`` [b+1] (monotone,
+    ``cu_seqlens[0] == 0``). Tokens past ``cu_seqlens[-1]`` get id ``b``
+    (a padding segment that only attends to itself)."""
+    pos = jnp.arange(total, dtype=jnp.int32)
+    return jnp.searchsorted(
+        cu_seqlens.astype(jnp.int32)[1:], pos, side="right"
+    ).astype(jnp.int32)
+
+
+def flash_attention_varlen(
+    q: jax.Array,  # [total, n, d] packed tokens
+    k: jax.Array,
+    v: jax.Array,
+    cu_seqlens: jax.Array,  # [b+1] cumulative sequence starts, cu[0] == 0
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
 ) -> jax.Array:
-    """Materialised-score reference (for tests): same math, O(s^2)."""
+    """Packed variable-length self-attention — the reference fmha's primary
+    mode (``apex/contrib/fmha/fmha.py:33-92``: qkv ``[total, ...]`` +
+    ``cu_seqlens``, seq<=512 fp16; here any length/dtype).
+
+    Tokens attend only within their own sequence (per-token segment ids
+    derived from ``cu_seqlens``; causal uses the packed global order, which
+    equals local order inside each contiguous segment). O(total) memory —
+    no padding to ``[b, s_max]`` and no [s, s] score tensor.
+    """
+    total, n, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    seed = _resolve_seed(dropout_p, dropout_seed)
+    segs = segment_ids_from_cu_seqlens(cu_seqlens, total)
+    qb = q.transpose(1, 0, 2)[None]  # [1, n, total, d]
+    kb = k.transpose(1, 0, 2)[None]
+    vb = v.transpose(1, 0, 2)[None]
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True
+    o = _flash(
+        qb, kb, vb, None, (segs, segs), seed, float(scale), bool(causal),
+        float(dropout_p), int(block_q), int(block_k), bool(interpret),
+    )
+    return o[0].transpose(1, 0, 2)  # [total, n, d]
+
+
+def mha_reference(
+    q, k, v, *, causal=False, kv_mask=None, scale=None, dropout_p=0.0,
+    dropout_seed=None,
+) -> jax.Array:
+    """Materialised-score reference (for tests): same math, O(s^2) — incl.
+    the kernels' exact hash-dropout mask and the zeros-for-fully-masked-rows
+    convention."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum(
@@ -520,7 +780,41 @@ def mha_reference(
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :] != 0, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # zeros-for-fully-masked-rows (flash kernel convention): a row whose
+    # keys are all masked outputs 0, not the uniform average softmax yields
+    row_alive = jnp.any(s > _NEG_INF / 2, axis=-1, keepdims=True)
+    p = jnp.where(row_alive, p, 0.0)
+    seed = _resolve_seed(dropout_p, dropout_seed)
+    if seed is not None:
+        b, n, sq, sk = p.shape
+        keep = dropout_mask_reference(seed, b, n, sq, sk, dropout_p)
+        p = p * keep * (1.0 / (1.0 - dropout_p))
     return jnp.einsum(
         "bnqk,bnkd->bnqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+def mha_reference_varlen(
+    q, k, v, cu_seqlens, *, causal=False, scale=None
+) -> jax.Array:
+    """Per-sequence XLA reference for varlen tests: slice each sequence,
+    run dense attention, concatenate."""
+    total, n, d = q.shape
+    segs = segment_ids_from_cu_seqlens(cu_seqlens, total)
+    seg_mask = segs[:, None] == segs[None, :]  # [total, total]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum(
+        "qnd,knd->nqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    s = jnp.where(seg_mask[None], s, _NEG_INF)
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (total, total), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (total, total), 1)
+        s = jnp.where((ki > qi)[None], _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "nqk,knd->qnd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)

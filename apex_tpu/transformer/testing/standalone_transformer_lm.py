@@ -221,8 +221,10 @@ def parallel_attention(
 
     # --- flash attention path (Pallas, O(s) memory) ---------------------
     # Replaces the materialised-[b,np,sq,sk] scores below when applicable:
-    # no traced per-layer scaling, no attention dropout this call, and a
-    # mask expressible as causal or key-padding ([b,1,1,sk]-broadcast).
+    # no traced per-layer scaling, and a mask expressible as causal or
+    # key-padding ([b,1,1,sk]-broadcast). Attention dropout runs IN-KERNEL
+    # (hash counters, the reference fmha's Philox analogue) so dropout > 0
+    # no longer re-materialises [s,s] probabilities.
     # In causal mode any provided mask is ignored on every path — parity
     # with the reference's upper-triangular kernel, which takes no mask.
     causal = cfg.attn_mask_type == AttnMaskType.causal
@@ -237,31 +239,49 @@ def parallel_attention(
     ):
         kv_mask = attention_mask[:, 0, 0, :] == 0  # True = attend
         mask_ok = True
-    flash_compatible = (
-        not qk_scaling
-        and (deterministic or cfg.attention_dropout == 0.0
-             or dropout_key is None)
-        and mask_ok
+    attn_dropout_p = (
+        0.0 if deterministic or dropout_key is None
+        else float(cfg.attention_dropout)
     )
+    flash_compatible = not qk_scaling and mask_ok
     if cfg.use_flash_attention is None:
         use_flash = flash_compatible and flash_attention_available(s, s, hn)
     elif cfg.use_flash_attention:
         if not flash_compatible:
             raise ValueError(
                 "use_flash_attention=True but the configuration is not "
-                "flash-compatible (traced qk scaling, attention dropout, "
-                "or a non-causal/non-padding mask)"
+                "flash-compatible (traced qk scaling or a non-causal/"
+                "non-padding mask)"
+            )
+        if s % 8 != 0 or hn > 256:
+            # the TPU-tileability rule of flash_attention_available, checked
+            # on every backend so a forced-on config fails loudly in CPU
+            # tests rather than at TPU compile time
+            raise ValueError(
+                f"use_flash_attention=True but the shapes are not kernel-"
+                f"tileable (seq {s} % 8 != 0 or head dim {hn} > 256)"
             )
         use_flash = True
     else:
         use_flash = False
 
     if use_flash:
+        flash_kw = {}
+        if attn_dropout_p > 0.0:
+            # int32 seed derived from the step's dropout key: the kernel
+            # regenerates the identical mask in backward from this counter
+            flash_kw = dict(
+                dropout_p=attn_dropout_p,
+                dropout_seed=jax.random.randint(
+                    dropout_key, (), -(2 ** 31), 2 ** 31 - 1, jnp.int32
+                ),
+            )
         ctx = flash_attention_sbhd(
             q, kk, vv,
             causal=causal,
             kv_mask=kv_mask,
             scale=1.0 / (hn ** 0.5),
+            **flash_kw,
         ).astype(hidden.dtype)
         ctx = ctx.reshape(s, b, np_local * hn)
     else:

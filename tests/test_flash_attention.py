@@ -163,3 +163,198 @@ def test_bert_flash_matches_xla_path():
     # compare only non-padded query positions (padded queries attend to
     # everything in both paths but their logits are irrelevant)
     assert jnp.abs(lf[:, :24] - lx[:, :24]).max() < 1e-4
+
+
+# ---------------------------------------------------------------------- new
+# in-kernel dropout + varlen (cu_seqlens)
+
+
+def test_flash_dropout_matches_reference_mask():
+    """The kernel's hash dropout must equal mha_reference's materialised
+    mask elementwise (same counters), at any block size."""
+    from apex_tpu.ops.flash_attention import mha_reference
+
+    q, k, v = _qkv(jax.random.PRNGKey(0), s=64)
+    for blocks in ((512, 512), (16, 32)):
+        out = flash_attention(
+            q, k, v, dropout_p=0.3, dropout_seed=123,
+            block_q=blocks[0], block_k=blocks[1],
+        )
+        ref = mha_reference(q, k, v, dropout_p=0.3, dropout_seed=123)
+        assert jnp.abs(out - ref).max() < 2e-5, blocks
+
+
+def test_flash_dropout_zero_p_equals_no_dropout():
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    a = flash_attention(q, k, v)
+    b = flash_attention(q, k, v, dropout_p=0.0, dropout_seed=7)
+    assert jnp.array_equal(a, b)
+
+
+def test_flash_dropout_requires_seed():
+    q, k, v = _qkv(jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="dropout_seed"):
+        flash_attention(q, k, v, dropout_p=0.1)
+
+
+def test_flash_dropout_rate_and_seed_dependence():
+    from apex_tpu.ops.flash_attention import dropout_mask_reference
+
+    m1 = dropout_mask_reference(11, 1, 2, 128, 128, 0.25)
+    m2 = dropout_mask_reference(12, 1, 2, 128, 128, 0.25)
+    rate = 1.0 - float(m1.mean())
+    assert abs(rate - 0.25) < 0.02
+    assert not jnp.array_equal(m1, m2)  # seed changes the mask
+    # heads get distinct masks
+    assert not jnp.array_equal(m1[0, 0], m1[0, 1])
+
+
+def test_flash_dropout_grads_match_reference():
+    """Backward regenerates the identical mask: grads must equal autodiff
+    through the materialised-mask reference."""
+    from apex_tpu.ops.flash_attention import mha_reference
+
+    q, k, v = _qkv(jax.random.PRNGKey(3), s=32)
+
+    def f_flash(q, k, v):
+        return (flash_attention(
+            q, k, v, causal=True, dropout_p=0.2, dropout_seed=99,
+        ) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (mha_reference(
+            q, k, v, causal=True, dropout_p=0.2, dropout_seed=99,
+        ) ** 2).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        assert jnp.abs(gf - gr).max() < 5e-4, name
+
+
+def _packed(key, lens, n=2, d=16, pad_to=None):
+    total = sum(lens)
+    if pad_to:
+        total = pad_to
+    cu = jnp.asarray(np_cumsum0(lens), jnp.int32)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (total, n, d), jnp.float32)
+    k = jax.random.normal(kk, (total, n, d), jnp.float32)
+    v = jax.random.normal(kv, (total, n, d), jnp.float32)
+    return q, k, v, cu
+
+
+def np_cumsum0(lens):
+    import numpy as np
+
+    return np.concatenate([[0], np.cumsum(lens)])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_varlen_matches_per_sequence_reference(causal):
+    from apex_tpu.ops.flash_attention import (
+        flash_attention_varlen,
+        mha_reference,
+    )
+    import numpy as np
+
+    lens = [24, 8, 32]  # total 64
+    q, k, v, cu = _packed(jax.random.PRNGKey(4), lens)
+    out = flash_attention_varlen(q, k, v, cu, causal=causal)
+
+    # reference: run each sequence separately through dense attention
+    for i, L in enumerate(lens):
+        s, e = int(cu[i]), int(cu[i + 1])
+        ref = mha_reference(
+            q[s:e].transpose(1, 0, 2)[None],
+            k[s:e].transpose(1, 0, 2)[None],
+            v[s:e].transpose(1, 0, 2)[None],
+            causal=causal,
+        )[0].transpose(1, 0, 2)
+        np.testing.assert_allclose(
+            np.asarray(out[s:e]), np.asarray(ref), atol=2e-5,
+            err_msg=f"sequence {i}",
+        )
+
+
+def test_flash_varlen_grads_match_reference():
+    from apex_tpu.ops.flash_attention import (
+        flash_attention_varlen,
+        mha_reference_varlen,
+    )
+    import numpy as np
+
+    lens = [16, 48]
+    q, k, v, cu = _packed(jax.random.PRNGKey(5), lens)
+
+    g_flash = jax.grad(
+        lambda q, k, v: (flash_attention_varlen(q, k, v, cu, causal=True) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (mha_reference_varlen(q, k, v, cu, causal=True) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, err_msg=name
+        )
+
+
+def test_flash_varlen_padding_tail_isolated():
+    """Tokens past cu_seqlens[-1] form their own padding segment and must
+    not influence real sequences."""
+    from apex_tpu.ops.flash_attention import flash_attention_varlen
+    import numpy as np
+
+    lens = [24, 24]  # 48 real tokens, padded buffer of 64
+    q, k, v, cu = _packed(jax.random.PRNGKey(6), lens, pad_to=64)
+    out = flash_attention_varlen(q, k, v, cu)
+    q2 = q.at[48:].set(1e3)  # poison the padding tokens
+    k2 = k.at[48:].set(1e3)
+    v2 = v.at[48:].set(1e3)
+    out2 = flash_attention_varlen(q2, k2, v2, cu)
+    np.testing.assert_allclose(
+        np.asarray(out[:48]), np.asarray(out2[:48]), atol=1e-6
+    )
+
+
+def test_segment_ids_from_cu_seqlens():
+    from apex_tpu.ops.flash_attention import segment_ids_from_cu_seqlens
+    import numpy as np
+
+    cu = jnp.asarray([0, 3, 3, 7], jnp.int32)  # empty middle sequence
+    segs = segment_ids_from_cu_seqlens(cu, 9)
+    np.testing.assert_array_equal(
+        np.asarray(segs), [0, 0, 0, 2, 2, 2, 2, 3, 3]
+    )
+
+
+def test_gpt_flash_with_attention_dropout():
+    """Attention dropout now runs in-kernel on the flash path: a forced-on
+    flash config with attention_dropout > 0 must train (no raise), be
+    deterministic per key, and vary across keys."""
+    from apex_tpu.transformer.testing import GPTConfig, gpt_loss, init_gpt_params
+
+    cfg = GPTConfig(
+        num_layers=2, hidden_size=64, num_attention_heads=2, vocab_size=128,
+        max_position_embeddings=32, hidden_dropout=0.0,
+        attention_dropout=0.25, use_flash_attention=True,
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    k = jax.random.PRNGKey(5)
+    l1 = gpt_loss(cfg, params, tokens, labels, dropout_key=k, deterministic=False)
+    l2 = gpt_loss(cfg, params, tokens, labels, dropout_key=k, deterministic=False)
+    l3 = gpt_loss(cfg, params, tokens, labels,
+                  dropout_key=jax.random.PRNGKey(9), deterministic=False)
+    ld = gpt_loss(cfg, params, tokens, labels, deterministic=True)
+    assert float(l1) == float(l2)      # same key -> same in-kernel mask
+    assert float(l1) != float(l3)      # key changes the mask
+    assert float(l1) != float(ld)      # dropout actually active
+    # grads flow through the dropped kernel
+    g = jax.grad(lambda p: gpt_loss(cfg, p, tokens, labels, dropout_key=k,
+                                    deterministic=False))(params)
+    assert all(jnp.isfinite(x).all() for x in jax.tree_util.tree_leaves(g))
