@@ -5,5 +5,39 @@ Python wrappers). Subpackages, mirroring the reference's layout:
 
 - ``contrib.optimizers`` — ZeRO-2 sharded optimizers
   (``DistributedFusedAdam``, ``DistributedFusedLAMB``) + legacy aliases
+- ``contrib.clip_grad`` — fused-l2norm ``clip_grad_norm_``
+- ``contrib.xentropy`` — ``SoftmaxCrossEntropyLoss`` (label smoothing)
+- ``contrib.layer_norm`` — ``FastLayerNorm`` over the Pallas kernels
+- ``contrib.group_norm`` — NHWC GroupNorm (+swish)
+- ``contrib.focal_loss`` — fused focal loss
+- ``contrib.index_mul_2d`` — indexed elementwise multiply
+- ``contrib.sparsity`` — ASP 2:4 structured sparsity
+- ``contrib.bottleneck`` — (spatial-parallel) ResNet bottleneck + the
+  ppermute halo exchangers (``HaloExchanger{NoComm,AllGather,SendRecv,Peer}``)
 """
+import importlib
+
 from . import optimizers  # noqa: F401
+
+_LAZY = (
+    "clip_grad",
+    "xentropy",
+    "layer_norm",
+    "group_norm",
+    "focal_loss",
+    "index_mul_2d",
+    "sparsity",
+    "bottleneck",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,17 @@
+from apex_tpu.contrib.bottleneck.halo_exchangers import (  # noqa: F401
+    HaloExchanger,
+    HaloExchangerAllGather,
+    HaloExchangerNoComm,
+    HaloExchangerPeer,
+    HaloExchangerSendRecv,
+    halo_pad_1d,
+)
+from apex_tpu.contrib.bottleneck.bottleneck import spatial_conv3x3  # noqa: F401
+
+try:
+    from apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+        Bottleneck,
+        SpatialBottleneck,
+    )
+except ImportError:  # pragma: no cover - flax unavailable
+    pass
