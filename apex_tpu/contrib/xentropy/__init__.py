@@ -1,3 +1,11 @@
-from .softmax_xentropy import SoftmaxCrossEntropyLoss, softmax_cross_entropy_loss
+from .softmax_xentropy import (
+    SoftmaxCrossEntropyLoss,
+    lm_head_cross_entropy,
+    softmax_cross_entropy_loss,
+)
 
-__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+__all__ = [
+    "SoftmaxCrossEntropyLoss",
+    "softmax_cross_entropy_loss",
+    "lm_head_cross_entropy",
+]

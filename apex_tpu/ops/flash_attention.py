@@ -55,6 +55,8 @@ _NEG_INF = -1e30
 
 
 def _pick_block(s: int, want: int) -> int:
+    # NB: 512-row blocks hit a Mosaic DMA pathology on v5e (~10x slow);
+    # default block_q stays at 256
     for cand in (want, 512, 256, 128, 64, 32, 16, 8):
         if cand <= want and s % cand == 0:
             return cand
@@ -188,8 +190,11 @@ def _fwd_kernel(
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        # dots run in the INPUT dtype with fp32 accumulation — bf16 inputs
+        # hit the MXU's native rate; upcasting first would force the slow
+        # fp32 matmul path
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -363,8 +368,8 @@ def _bwd_dq_kernel(
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -377,9 +382,9 @@ def _bwd_dq_kernel(
         lse = lse_ref[0, 0][:, :1]  # [bq, 1]
         p = jnp.exp(s - lse)
         p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]
         dp = jax.lax.dot_general(
-            do, v_ref[0, 0].astype(jnp.float32),
+            do, v_ref[0, 0],
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -422,8 +427,8 @@ def _bwd_dkv_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -436,7 +441,7 @@ def _bwd_dkv_kernel(
         lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(s - lse)
         p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]
         if dropout_p > 0.0:
             bh = ib * n_heads + ih
             keep = _keep_mask(seed_ref[0], bh, qi, ki, dropout_p)
@@ -447,12 +452,11 @@ def _bwd_dkv_kernel(
             p_d = p
         # dv += p_d.T @ do
         dv_scr[:] += jax.lax.dot_general(
-            p_d, do, (((0,), (0,)), ((), ())),
+            p_d.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
-            do, v_ref[0, 0].astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if keep is not None:
@@ -461,7 +465,7 @@ def _bwd_dkv_kernel(
         ds = p * (dp - delta)  # [bq, bk]
         # dk += ds.T @ q * scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
 
@@ -686,6 +690,10 @@ def flash_attention(
     if kv_mask is not None:
         kv_mask = kv_mask.astype(jnp.int8)
     seed = _resolve_seed(dropout_p, dropout_seed)
+    # kernel dots run in the operand dtype (MXU-native); normalise mixed
+    # inputs to q's dtype so e.g. (fp32 q, bf16 k/v) still compiles
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     # off-TPU the kernel runs in the Pallas interpreter (tests exercise the
     # same code path the TPU compiles)
     if not interpret and jax.default_backend() != "tpu":
@@ -748,6 +756,8 @@ def flash_attention_varlen(
         scale = 1.0 / (d ** 0.5)
     seed = _resolve_seed(dropout_p, dropout_seed)
     segs = segment_ids_from_cu_seqlens(cu_seqlens, total)
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     qb = q.transpose(1, 0, 2)[None]  # [1, n, total, d]
     kb = k.transpose(1, 0, 2)[None]
     vb = v.transpose(1, 0, 2)[None]

@@ -69,7 +69,7 @@ class GPTConfig:
     sequence_parallel: bool = False
     apply_query_key_layer_scaling: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
-    recompute_granularity: Optional[str] = None  # None | "full"
+    recompute_granularity: Optional[str] = None  # None | "full" | "selective"
     # None = auto (Pallas flash attention when available & applicable);
     # True forces it (errors if inapplicable); False forces the XLA path.
     use_flash_attention: Optional[bool] = None
@@ -421,7 +421,9 @@ def transformer_block(
 
     ``recompute_granularity="full"`` rematerialises each layer in backward —
     the reference's ``--recompute-granularity full`` activation
-    checkpointing (``tensor_parallel/random.py:237``).
+    checkpointing (``tensor_parallel/random.py:237``); ``"selective"``
+    keeps matmul outputs and replays only the cheap elementwise/softmax work
+    (the reference's ``--recompute-granularity selective``).
     """
     L = layer_params["qkv_w"].shape[0]
 
@@ -439,6 +441,16 @@ def transformer_block(
 
     if cfg.recompute_granularity == "full":
         body = jax.checkpoint(body)
+    elif cfg.recompute_granularity == "selective":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif cfg.recompute_granularity is not None:
+        raise ValueError(
+            f"unknown recompute_granularity "
+            f"{cfg.recompute_granularity!r}: use None, 'full' or 'selective'"
+        )
 
     (hidden, _), _ = jax.lax.scan(
         body, (hidden, dropout_key),
@@ -483,7 +495,7 @@ def gpt_embed(
     return _dropout(emb, cfg.hidden_dropout, dropout_key, deterministic)
 
 
-def gpt_forward(
+def gpt_hidden(
     cfg: GPTConfig,
     params: Pytree,
     tokens: jax.Array,  # [b, s]
@@ -491,8 +503,9 @@ def gpt_forward(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
 ) -> jax.Array:
-    """Full GPT forward → vocab(-parallel) logits [b, s, v(/tp)]
-    (reference ``GPTModel.forward`` + ``post_language_model_processing``)."""
+    """GPT trunk → pre-head hidden states [s, b, h] (embeddings, layer
+    stack, final LN, SP gather) — everything of ``gpt_forward`` except the
+    LM-head projection."""
     k_embed = k_block = None
     if dropout_key is not None:
         if axis_name is not None and cfg.sequence_parallel:
@@ -523,6 +536,22 @@ def gpt_forward(
         hidden = mappings.gather_from_sequence_parallel_region(
             hidden, axis_name
         )
+    return hidden
+
+
+def gpt_forward(
+    cfg: GPTConfig,
+    params: Pytree,
+    tokens: jax.Array,  # [b, s]
+    axis_name: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Full GPT forward → vocab(-parallel) logits [b, s, v(/tp)]
+    (reference ``GPTModel.forward`` + ``post_language_model_processing``)."""
+    hidden = gpt_hidden(
+        cfg, params, tokens, axis_name, dropout_key, deterministic
+    )
     logits = _lm_head(cfg, params, hidden, axis_name)
     return jnp.transpose(logits, (1, 0, 2))  # [b, s, v(/tp)]
 
@@ -552,15 +581,41 @@ def gpt_loss(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
 ) -> jax.Array:
-    """Masked mean LM loss (reference GPT ``loss_func``)."""
-    logits = gpt_forward(
-        cfg, params, tokens, axis_name, dropout_key, deterministic
-    )
+    """Masked mean LM loss (reference GPT ``loss_func``).
+
+    Single-device path: the head GEMM and the CE are chunk-fused
+    (``contrib.xentropy.lm_head_cross_entropy``) so the ``[b*s, vocab]``
+    fp32 logits tensor is never fully materialised; TP path: vocab-parallel
+    CE over the sharded logits.
+    """
     if axis_name is not None:
+        logits = gpt_forward(
+            cfg, params, tokens, axis_name, dropout_key, deterministic
+        )
         losses = vocab_parallel_cross_entropy(logits, labels, 0.0, axis_name)
     else:
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        losses = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+        from apex_tpu.contrib.xentropy import lm_head_cross_entropy
+
+        hidden = gpt_hidden(
+            cfg, params, tokens, axis_name, dropout_key, deterministic
+        )
+        s, b, h = hidden.shape
+        n = s * b
+        # largest divisor of n that is <= 2048: keeps the chunked-CE memory
+        # guarantee for any batch/seq (falling back to n would materialise
+        # exactly the [n, vocab] block this path exists to avoid)
+        chunk = 1
+        for cand in range(min(2048, n), 0, -1):
+            if n % cand == 0:
+                chunk = cand
+                break
+        losses = lm_head_cross_entropy(
+            hidden.reshape(n, h),
+            params["embedding"]["word"],
+            jnp.transpose(labels, (1, 0)).reshape(n),  # [s, b] row order
+            chunk_size=chunk,
+        ).reshape(s, b)
+        losses = jnp.transpose(losses, (1, 0))  # [b, s]
     if loss_mask is None:
         return jnp.mean(losses)
     m = loss_mask.astype(jnp.float32)
