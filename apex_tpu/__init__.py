@@ -84,6 +84,7 @@ _LAZY_SUBMODULES = (
     "fused_dense",
     "ops",
     "RNN",
+    "checkpoint",
 )
 
 
@@ -103,4 +104,4 @@ def __getattr__(name):
 
 
 def __dir__():
-    return sorted(list(globals().keys()) + list(_LAZY_SUBMODULES))
+    return sorted(set(globals()) | set(_LAZY_SUBMODULES))

@@ -1,0 +1,88 @@
+"""Checkpoint save/load — tensorstore-backed, sharding-aware.
+
+The reference scatters checkpointing across per-component ``state_dict``s
+(amp scaler ``apex/amp/frontend.py:365-404``, ``FP16_Optimizer.state_dict``
+``fp16_utils/fp16_optimizer.py:212-273``, DistributedFusedAdam's v1
+gather-on-root / v2 per-rank-shard formats
+``contrib/optimizers/distributed_fused_adam.py:2956-3555``) and leaves the
+file IO to ``torch.save`` or cuFile (``csrc/gpu_direct_storage/gds.cpp``).
+
+TPU-native: orbax/tensorstore owns the device<->storage path (the
+GPUDirect-Storage analogue — XLA device buffers stream to storage without a
+host round-trip where the platform supports it), and **sharded jax.Arrays
+checkpoint natively**: each host writes its own shards (the v2 format's
+property), and restore takes an abstract target carrying the desired
+shardings so a checkpoint can be loaded onto a different mesh layout
+(the v1 gather/rescatter property) — both formats collapse into one
+mechanism here.
+
+API::
+
+    save_checkpoint(path, {"params": params, "opt_state": state, "step": 3})
+    restored = load_checkpoint(path)                      # host numpy
+    restored = load_checkpoint(path, target=abstract_or_concrete_tree)
+    # target leaves may be jax.ShapeDtypeStruct(shape, dtype, sharding=...)
+
+``amp.AmpState``/scaler states and the fused optimizers' NamedTuple states
+are plain pytrees — they round-trip as-is.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, state: Pytree, *, overwrite: bool = True) -> None:
+    """Write a pytree of (possibly sharded) arrays/scalars to ``path``.
+
+    Sharded ``jax.Array`` leaves are written shard-by-shard (every process
+    writes only its addressable shards — the reference's v2 sharded format,
+    ``distributed_fused_adam.py:3339+``); replicated and host values are
+    written once.
+    """
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=overwrite)
+    ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
+    """Read a checkpoint.
+
+    Without ``target``: returns host-side arrays in the saved structure.
+    With ``target``: a matching pytree of abstract leaves
+    (``jax.ShapeDtypeStruct`` with an optional ``sharding``) or concrete
+    arrays whose shardings describe where each leaf should land — restore
+    places shards directly on the right devices, including onto a
+    *different* mesh than the one that saved (the v1 format's
+    gather/rescatter capability without the gather).
+    """
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    if target is None:
+        return ckptr.restore(path)
+
+    def to_abstract(leaf):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return leaf
+        if isinstance(leaf, jax.Array):
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=leaf.sharding
+            )
+        if isinstance(leaf, np.ndarray):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        return leaf  # scalars and strings restore as saved
+
+    abstract = jax.tree_util.tree_map(to_abstract, target)
+    return ckptr.restore(path, abstract)

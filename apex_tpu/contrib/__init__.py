@@ -14,6 +14,8 @@ Python wrappers). Subpackages, mirroring the reference's layout:
 - ``contrib.sparsity`` — ASP 2:4 structured sparsity
 - ``contrib.bottleneck`` — (spatial-parallel) ResNet bottleneck + the
   ppermute halo exchangers (``HaloExchanger{NoComm,AllGather,SendRecv,Peer}``)
+- ``contrib.gpu_direct_storage`` — ``GDSFile`` raw tensor<->file IO
+  (whole-pytree sharded checkpointing lives in ``apex_tpu.checkpoint``)
 """
 import importlib
 
@@ -28,6 +30,7 @@ _LAZY = (
     "index_mul_2d",
     "sparsity",
     "bottleneck",
+    "gpu_direct_storage",
 )
 
 

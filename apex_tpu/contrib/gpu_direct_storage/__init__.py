@@ -1,0 +1,3 @@
+from apex_tpu.contrib.gpu_direct_storage.gds import GDSFile  # noqa: F401
+
+__all__ = ["GDSFile"]

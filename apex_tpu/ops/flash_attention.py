@@ -55,8 +55,9 @@ _NEG_INF = -1e30
 
 
 def _pick_block(s: int, want: int) -> int:
-    # NB: 512-row blocks hit a Mosaic DMA pathology on v5e (~10x slow);
-    # default block_q stays at 256
+    # Block defaults (512/512) were A/B-measured in-model on v5e (isolated
+    # micro-benchmarks are tunnel-latency-bound here and misleading):
+    # 512 beat 256 for block_q end-to-end on the GPT-2 bench.
     for cand in (want, 512, 256, 128, 64, 32, 16, 8):
         if cand <= want and s % cand == 0:
             return cand

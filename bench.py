@@ -50,16 +50,17 @@ _PEAKS = (
 
 
 def detect_peak_tflops():
-    """(peak, recognised) from the first device's kind."""
+    """(peak, recognised) — BENCH_PEAK_TFLOPS overrides, then the
+    device-kind table."""
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env), True
     if jax.default_backend() != "tpu":
         return 10.0, False
     kind = jax.devices()[0].device_kind.lower()
     for marker, peak in _PEAKS:
         if marker in kind:
             return peak, True
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env), True
     return 197.0, False
 
 
@@ -188,7 +189,9 @@ def main() -> None:
             "the measurement is not timing real execution")
 
     vs_xla_attention = None
-    if not fast:
+    if not fast and not os.environ.get("APEX_TPU_DISABLE_FLASH"):
+        # (when the user already disabled flash, the headline IS the XLA
+        # path and the comparison is meaningless)
         os.environ["APEX_TPU_DISABLE_FLASH"] = "1"
         try:
             xla_step_s, _, _ = bench_gpt(iters, batch, seq, remat)

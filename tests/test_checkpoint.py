@@ -1,0 +1,156 @@
+"""Checkpoint tests: orbax-backed sharded save/load + GDSFile parity.
+
+Covers the reference's checkpoint surface (SURVEY §5): model/optimizer
+state round-trips, DistributedFusedAdam's sharded (v2) persistence with
+restore-onto-a-mesh, cross-layout restore (the v1 gather/rescatter
+capability), amp scaler state, and the GDSFile raw-tensor IO analogue.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.checkpoint import load_checkpoint, save_checkpoint
+from apex_tpu.contrib.gpu_direct_storage import GDSFile
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def test_roundtrip_host_pytree(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(12.0).reshape(3, 4),
+                   "b": jnp.zeros((4,), jnp.bfloat16)},
+        "step": jnp.int32(7),
+    }
+    save_checkpoint(str(tmp_path / "ck"), state)
+    back = load_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(np.asarray(back["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    assert back["params"]["b"].dtype == jnp.bfloat16
+    assert int(back["step"]) == 7
+
+
+def test_roundtrip_sharded_arrays(tmp_path):
+    """Sharded leaves save per-shard and restore onto the same mesh with
+    identical sharding and values (the v2 format property)."""
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("data"))
+    x = jax.device_put(jnp.arange(64.0).reshape(8, 8), sh)
+    rep = jax.device_put(jnp.ones((4,)), NamedSharding(mesh, P()))
+    state = {"x": x, "rep": rep}
+    save_checkpoint(str(tmp_path / "ck"), state)
+
+    restored = load_checkpoint(str(tmp_path / "ck"), target=state)
+    assert restored["x"].sharding == sh
+    np.testing.assert_array_equal(np.asarray(restored["x"]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(restored["rep"]), np.asarray(rep))
+
+
+def test_restore_onto_different_layout(tmp_path):
+    """A checkpoint saved data-sharded restores replicated (and vice
+    versa) — the v1 gather/rescatter capability without the gather."""
+    mesh = _mesh()
+    x = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                       NamedSharding(mesh, P("data")))
+    save_checkpoint(str(tmp_path / "ck"), {"x": x})
+
+    target = {"x": jax.ShapeDtypeStruct(
+        (8, 8), jnp.float32, sharding=NamedSharding(mesh, P(None, "data")))}
+    restored = load_checkpoint(str(tmp_path / "ck"), target=target)
+    assert restored["x"].sharding.spec == P(None, "data")
+    np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                  np.asarray(x))
+
+
+def test_distributed_fused_adam_state_roundtrip(tmp_path):
+    """ZeRO-2 optimizer state: save mid-training, restore, training
+    continues bit-identically (reference v1/v2 sharded state dicts,
+    distributed_fused_adam.py:2956-3555)."""
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    params = {"w": jnp.arange(32.0).reshape(4, 8) / 32.0,
+              "b": jnp.zeros((8,))}
+    opt = DistributedFusedAdam(lr=1e-2, distributed_size=8)
+    mesh = _mesh()
+
+    def step(params, state, grads):
+        def local(params, state, grads):
+            return opt.step(grads, state, params)
+
+        specs = opt.state_specs()
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), specs, P()),
+            out_specs=(P(), specs), check_vma=False,
+        )(params, state, grads)
+
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    params1, state1 = step(params, state, grads)
+
+    save_checkpoint(str(tmp_path / "ck"),
+                    {"params": params1, "opt": state1._asdict()})
+    back = load_checkpoint(str(tmp_path / "ck"),
+                           target={"params": params1,
+                                   "opt": state1._asdict()})
+    state_re = type(state1)(**back["opt"])
+
+    p_a, _ = step(params1, state1, grads)
+    p_b, _ = step(back["params"], state_re, grads)
+    for ka in p_a:
+        np.testing.assert_array_equal(np.asarray(p_a[ka]), np.asarray(p_b[ka]))
+
+
+def test_amp_scaler_state_roundtrip(tmp_path):
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler("dynamic", init_scale=2.0 ** 12)
+    st = scaler.init_state()
+    st = st._replace(loss_scale=jnp.float32(1024.0), unskipped=jnp.int32(17))
+    save_checkpoint(str(tmp_path / "ck"), st._asdict())
+    back = load_checkpoint(str(tmp_path / "ck"))
+    assert float(back["loss_scale"]) == 1024.0
+    assert int(back["unskipped"]) == 17
+
+
+def test_gdsfile_roundtrip(tmp_path):
+    fn = str(tmp_path / "t.bin")
+    x = jnp.arange(24, dtype=jnp.float32).reshape(4, 6) * 1.5
+    with GDSFile(fn, "w") as f:
+        f.save_data(x)
+    with GDSFile(fn, "r") as f:
+        y = f.load_data(jnp.zeros_like(x))
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_gdsfile_bf16_and_multiple_tensors(tmp_path):
+    fn = str(tmp_path / "t.bin")
+    a = jnp.arange(8, dtype=jnp.bfloat16)
+    b = jnp.ones((2, 3), jnp.int32) * 7
+    with GDSFile(fn, "w") as f:
+        f.save_data(a)
+        f.save_data(b)
+    with GDSFile(fn, "r") as f:
+        a2 = f.load_data(jnp.zeros_like(a))
+        b2 = f.load_data(jnp.zeros_like(b))
+    np.testing.assert_array_equal(np.asarray(a2, np.float32),
+                                  np.asarray(a, np.float32))
+    np.testing.assert_array_equal(np.asarray(b2), np.asarray(b))
+
+
+def test_gdsfile_mode_enforcement(tmp_path):
+    fn = str(tmp_path / "t.bin")
+    x = jnp.zeros((2,))
+    with GDSFile(fn, "w") as f:
+        f.save_data(x)
+        with pytest.raises(RuntimeError):
+            f.load_data(x)
+    with GDSFile(fn, "r") as f:
+        with pytest.raises(RuntimeError):
+            f.save_data(x)
+    with pytest.raises(ValueError):
+        with GDSFile(fn, "x"):
+            pass
