@@ -665,6 +665,7 @@ def _resolve_seed(dropout_p, dropout_seed):
     return jnp.asarray(dropout_seed, jnp.int32)
 
 
+@jax.named_scope("apex_tpu.flash_attention")
 def flash_attention(
     q: jax.Array,  # [b, n, s_q, d]
     k: jax.Array,  # [b, n, s_k, d]

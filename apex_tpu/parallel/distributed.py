@@ -75,6 +75,7 @@ def unflatten(flat: jax.Array, tree: Pytree) -> Pytree:
     return jax.flatten_util.ravel_pytree(tree)[1](flat)
 
 
+@jax.named_scope("apex_tpu.sync_gradients")
 def sync_gradients(
     grads: Pytree,
     axis_name: str = "data",

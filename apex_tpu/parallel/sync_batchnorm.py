@@ -65,6 +65,7 @@ def _moments_over_axis(
     return mean, m2 / n, n
 
 
+@jax.named_scope("apex_tpu.sync_batch_norm")
 def sync_batch_norm(
     x: jax.Array,
     weight: Optional[jax.Array],

@@ -14,20 +14,22 @@ import jax
 
 
 class _Timer:
-    """Reference ``_timers.py:6-49``."""
+    """Reference ``_timers.py:6-49``. Uses the monotonic ``perf_counter``
+    clock (the reference's ``time.time`` can jump under NTP adjustments —
+    a negative or inflated interval in a benchmark)."""
 
     def __init__(self, name: str):
         self.name_ = name
         self.elapsed_ = 0.0
         self.started_ = False
-        self.start_time = time.time()
+        self.start_time = time.perf_counter()
 
     def start(self, barrier_on=None) -> None:
         if self.started_:
             raise RuntimeError("timer has already been started")
         if barrier_on is not None:
             jax.block_until_ready(barrier_on)
-        self.start_time = time.time()
+        self.start_time = time.perf_counter()
         self.started_ = True
 
     def stop(self, barrier_on=None) -> None:
@@ -35,7 +37,7 @@ class _Timer:
             raise RuntimeError("timer is not started")
         if barrier_on is not None:
             jax.block_until_ready(barrier_on)
-        self.elapsed_ += time.time() - self.start_time
+        self.elapsed_ += time.perf_counter() - self.start_time
         self.started_ = False
 
     def reset(self) -> None:

@@ -43,6 +43,7 @@ from ..utils import pvary_union_like, vma_tracking_active
 Pytree = Any
 
 
+@jax.named_scope("apex_tpu.pipeline_rounds")
 def pipeline_rounds(
     stage_fn: Callable,
     stage_params_chunks,  # tuple of per-chunk trees, or stacked tree + num_chunks

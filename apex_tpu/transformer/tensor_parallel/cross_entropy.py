@@ -22,6 +22,7 @@ from .. import parallel_state
 from .utils import VocabUtility
 
 
+@jax.named_scope("apex_tpu.vocab_parallel_cross_entropy")
 def vocab_parallel_cross_entropy(
     vocab_parallel_logits: jax.Array,
     target: jax.Array,

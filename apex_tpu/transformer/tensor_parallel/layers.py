@@ -55,6 +55,7 @@ def _axis(axis_name: Optional[str]) -> str:
 # Functional cores
 # --------------------------------------------------------------------------
 
+@jax.named_scope("apex_tpu.column_parallel_linear")
 def column_parallel_linear(
     x: jax.Array,
     weight: jax.Array,
@@ -102,6 +103,7 @@ def column_parallel_linear(
     return out, out_bias
 
 
+@jax.named_scope("apex_tpu.row_parallel_linear")
 def row_parallel_linear(
     x: jax.Array,
     weight: jax.Array,
@@ -145,6 +147,7 @@ def row_parallel_linear(
     return out, out_bias
 
 
+@jax.named_scope("apex_tpu.vocab_parallel_embedding")
 def vocab_parallel_embedding(
     ids: jax.Array,
     weight: jax.Array,

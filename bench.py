@@ -79,15 +79,21 @@ def train_flops_per_step(L, h, ffn, V, b, s, causal=True):
 
 
 def _timed_steps(step_fn, state, iters):
-    """Run chained steps; returns (dt_seconds, final_loss)."""
+    """Run chained steps via the Megatron-style Timers (the reference's
+    ``_Timer``/``Timers`` instrumentation, ``pipeline_parallel/_timers.py``);
+    returns (dt_seconds, final_loss)."""
+    from apex_tpu.transformer.pipeline_parallel._timers import Timers
+
+    timers = Timers()
     for _ in range(2):  # compile + warm
         state = step_fn(*state)
     float(state[-1])
-    t0 = time.perf_counter()
+    timers("train-steps").start()
     for _ in range(iters):
         state = step_fn(*state)
     final_loss = float(state[-1])  # true sync
-    return time.perf_counter() - t0, final_loss
+    timers("train-steps").stop()
+    return timers("train-steps").elapsed(reset=False), final_loss
 
 
 def bench_gpt(iters, batch, seq, remat):
