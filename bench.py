@@ -32,7 +32,6 @@ from __future__ import annotations
 import json
 import math
 import os
-import time
 
 import jax
 import jax.numpy as jnp

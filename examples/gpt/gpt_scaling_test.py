@@ -1,0 +1,149 @@
+"""GPT scaling harness — model-size x cpu_offload iteration-time curves.
+
+Port of the fork-added scaling study
+``/root/reference/tests/L0/run_transformer/gpt_scaling_test.py:7-50``: the
+reference launches GPT pretraining subprocesses over a model-size ladder
+(with and without CPU offload), parses "Average Iteration Time" and
+"Number of Parameters" from their stdout, and plots the scaling curves.
+
+Here each configuration runs in-process (one jitted train step per config —
+no subprocess needed when a fresh jit is a fresh program), prints the same
+two parse-compatible lines per run, writes ``gpt_scaling.json``, and saves
+``gpt_scaling.png`` when matplotlib is available.
+
+    python gpt_scaling_test.py                       # ladder on the TPU chip
+    python gpt_scaling_test.py --cpu 8 --steps 2 \
+        --layers 2 4                                 # CI smoke on a CPU mesh
+
+``--offload both`` (default) measures each size with and without the
+``cpu_offload`` activation-offload policy (the reference's
+``save_on_cpu`` study, ``standalone_gpt.py:59-61``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def parse():
+    p = argparse.ArgumentParser(description="GPT scaling study")
+    p.add_argument("--layers", type=int, nargs="+", default=[2, 4, 8, 12],
+                   help="model-size ladder (transformer layer counts)")
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=8192)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--offload", choices=["off", "on", "both"], default="both")
+    p.add_argument("--out", default="gpt_scaling.json")
+    p.add_argument("--plot", default="gpt_scaling.png")
+    p.add_argument("--cpu", type=int, default=0, metavar="N",
+                   help="force an N-virtual-device CPU backend (CI smoke)")
+    return p.parse_args()
+
+
+def run_config(cfg_args, layers, cpu_offload):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import GPTConfig
+    from apex_tpu.transformer.testing.standalone_gpt import gpt_model_provider
+
+    cfg = GPTConfig(
+        num_layers=layers,
+        hidden_size=cfg_args.hidden,
+        num_attention_heads=cfg_args.heads,
+        vocab_size=cfg_args.vocab,
+        max_position_embeddings=cfg_args.seq,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16,
+    )
+    params, _, loss_fn = gpt_model_provider(
+        cfg, jax.random.PRNGKey(0), cpu_offload=cpu_offload)
+    n_params = sum(
+        int(p.size) for p in jax.tree_util.tree_leaves(params))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg_args.batch, cfg_args.seq), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, labels))(params)
+        params, opt_state = opt.step(grads, opt_state, params)
+        return params, opt_state, loss
+
+    params, opt_state, loss = step(params, opt_state)  # compile
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(cfg_args.steps):
+        params, opt_state, loss = step(params, opt_state)
+    final = float(loss)  # true sync
+    avg_s = (time.perf_counter() - t0) / cfg_args.steps
+
+    # parse-compatible lines (reference greps these exact prefixes,
+    # gpt_scaling_test.py:17,33)
+    print(f"Number of Parameters: {n_params}")
+    print(f"Average Iteration Time: {avg_s:.6f} s")
+    return {
+        "layers": layers,
+        "cpu_offload": cpu_offload,
+        "n_params": n_params,
+        "avg_iteration_time_s": round(avg_s, 6),
+        "final_loss": round(final, 4),
+    }
+
+
+def main():
+    args = parse()
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.cpu}")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    offloads = {"off": [False], "on": [True], "both": [False, True]}[args.offload]
+    results = []
+    for layers in args.layers:
+        for off in offloads:
+            print(f"=== layers={layers} cpu_offload={off} ===")
+            results.append(run_config(args, layers, off))
+
+    with open(args.out, "w") as f:
+        json.dump({"config": vars(args), "results": results}, f, indent=2)
+    print(f"wrote {args.out}")
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        for off in offloads:
+            pts = [(r["n_params"] / 1e6, r["avg_iteration_time_s"] * 1e3)
+                   for r in results if r["cpu_offload"] == off]
+            ax.plot(*zip(*pts), marker="o",
+                    label=f"cpu_offload={'ON' if off else 'OFF'}")
+        ax.set_xlabel("parameters (M)")
+        ax.set_ylabel("avg iteration time (ms)")
+        ax.set_title("GPT scaling")
+        ax.legend()
+        fig.savefig(args.plot, dpi=120)
+        print(f"wrote {args.plot}")
+    except ImportError:
+        print("matplotlib unavailable; JSON only")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,7 @@ actually contain the expected collectives (on TPU the scheduler turns these
 into async start/done pairs overlapped with the GEMMs; the CPU backend
 compiles them synchronously, so presence+placement is what CI can pin).
 """
-import re
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,7 @@ def _mesh():
     return Mesh(np.array(jax.devices()), ("tensor",))
 
 
+@functools.cache
 def _compiled_tp_step():
     mesh = _mesh()
     x = jnp.zeros((64, 128))
@@ -58,11 +59,9 @@ def test_tp_linear_step_contains_expected_collectives():
     real all-reduces — if XLA ever elides or the mappings stop emitting
     them, gradients silently stop being synced."""
     txt = _compiled_tp_step()
-    n_allreduce = len(re.findall(r"all-reduce(?:-start)?\(|= all-reduce", txt))
     assert "all-reduce" in txt, "no all-reduce in compiled TP step"
     # fwd row-parallel reduce + bwd column-parallel dx reduce = >= 2
     assert txt.count("all-reduce") >= 2, txt.count("all-reduce")
-    del n_allreduce
 
 
 def test_named_scopes_reach_compiled_hlo():
@@ -84,8 +83,7 @@ def test_sync_gradients_scope_and_collective():
         lambda t: sync_gradients(t, "data"), mesh=mesh,
         in_specs=P("data"), out_specs=P("data"), check_vma=False,
     ))
-    txt = g.lower(jax.tree_util.tree_map(
-        lambda a: a, grads)).compile().as_text()
+    txt = g.lower(grads).compile().as_text()
     assert "all-reduce" in txt
     assert "apex_tpu.sync_gradients" in txt
 

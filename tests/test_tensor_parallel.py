@@ -348,3 +348,32 @@ def test_utils():
     assert len(parts) == 4 and parts[0].shape == (2, 2)
     first, last = tp.VocabUtility.vocab_range_from_global_vocab_size(64, 3, 8)
     assert (first, last) == (24, 32)
+
+
+def test_gather_seq_split_backward_under_vma_tracking():
+    """The to_model_parallel=False gather (custom-vjp slice backward) must
+    work under check_vma=True — the mode the rest of the SP stack runs in
+    (ADVICE r2: its only test used check_vma=False)."""
+    seq = TP * 2
+    x = jax.random.normal(jax.random.PRNGKey(7), (seq, 3))
+
+    def local_loss(xl):
+        y = tp.gather_from_sequence_parallel_region(xl, "tensor", False)
+        return jnp.sum(y * y)
+
+    g = _smap(
+        lambda xl: jax.grad(local_loss)(xl),
+        P("tensor"), P("tensor"), check_vma=True,
+    )(x)
+    # backward takes this rank's slice of the (identical) cotangent: 2x
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x), rtol=1e-6)
+
+    # forward value still the all-gather (pmean to leave the vma region:
+    # the gathered copies are identical, so the mean IS the gather)
+    out = _smap(
+        lambda xl: jax.lax.pmean(
+            tp.gather_from_sequence_parallel_region(xl, "tensor", False),
+            "tensor"),
+        P("tensor"), P(), check_vma=True,
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
