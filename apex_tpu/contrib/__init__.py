@@ -16,6 +16,14 @@ Python wrappers). Subpackages, mirroring the reference's layout:
   ppermute halo exchangers (``HaloExchanger{NoComm,AllGather,SendRecv,Peer}``)
 - ``contrib.gpu_direct_storage`` — ``GDSFile`` raw tensor<->file IO
   (whole-pytree sharded checkpointing lives in ``apex_tpu.checkpoint``)
+- ``contrib.transducer`` — RNN-T joint (+packing/epilogues) and loss
+- ``contrib.multihead_attn`` — fused self/encdec MHA modules (bias,
+  norm-add residual, additive/padding masks, in-kernel dropout)
+- ``contrib.conv_bias_relu`` — fused Conv+Bias(+ReLU/+Mask) ops
+- ``contrib.groupbn`` / ``contrib.cudnn_gbn`` — NHWC group-synced
+  BatchNorm (+add/relu epilogues)
+- ``contrib.openfold`` — ``FusedAdamSWA`` (Adam + stochastic weight
+  averaging in one fused step; the ``openfold_triton`` pack's optimizer)
 """
 import importlib
 
@@ -31,6 +39,12 @@ _LAZY = (
     "sparsity",
     "bottleneck",
     "gpu_direct_storage",
+    "transducer",
+    "multihead_attn",
+    "conv_bias_relu",
+    "groupbn",
+    "cudnn_gbn",
+    "openfold",
 )
 
 

@@ -1,0 +1,13 @@
+from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (  # noqa: F401
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)
+
+__all__ = [
+    "ConvBiasReLU",
+    "ConvBiasMaskReLU",
+    "ConvBias",
+    "ConvFrozenScaleBiasReLU",
+]

@@ -1,0 +1,60 @@
+"""Fused Conv + Bias (+ReLU / +Mask) — TPU-native.
+
+Reference: ``apex/contrib/conv_bias_relu/conv_bias_relu.py`` over
+``csrc/conv_bias_relu/conv_bias_relu.cpp`` (2.2k LoC of cuDNN-frontend
+graph building): four autograd functions fusing a conv with its bias and
+activation epilogues — ``ConvBiasReLU``, ``ConvBiasMaskReLU``,
+``ConvBias``, ``ConvFrozenScaleBiasReLU``.
+
+On TPU the XLA fusion pass IS the cuDNN-frontend analogue: writing the
+composition as plain ops compiles to one fused kernel chain, and autodiff
+provides the backward the reference hand-builds. NHWC layout (the
+reference kernels are channels-last too); ``padding``/``stride`` are
+ints applied symmetrically to H and W, matching the reference call shape
+``f(x, weight, bias, padding, stride)``.
+
+Weights are ``[kh, kw, cin, cout]`` (HWIO); biases/scales ``[cout]``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv(x, weight, padding: int, stride: int):
+    return jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def ConvBiasReLU(x, weight, bias, padding: int, stride: int):
+    """relu(conv(x, w) + b) — reference ``ConvBiasReLU_`` (``:12-31``)."""
+    return jax.nn.relu(_conv(x, weight, padding, stride)
+                       + bias.astype(x.dtype))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, padding: int, stride: int):
+    """relu((conv(x, w) + b) * mask) — reference ``ConvBiasMaskReLU_``
+    (``:34-53``); ``mask`` broadcasts against the conv output."""
+    return jax.nn.relu(
+        (_conv(x, weight, padding, stride) + bias.astype(x.dtype))
+        * mask.astype(x.dtype))
+
+
+def ConvBias(x, weight, bias, padding: int, stride: int):
+    """conv(x, w) + b — reference ``ConvBias_`` (``:56-75``)."""
+    return _conv(x, weight, padding, stride) + bias.astype(x.dtype)
+
+
+def ConvFrozenScaleBiasReLU(x, weight, scale, bias, padding: int, stride: int):
+    """relu(conv(x, w) * scale + b) with frozen (non-differentiated)
+    scale/bias — the folded-BatchNorm inference epilogue (reference
+    ``ConvFrozenScaleBiasReLU_``)."""
+    scale = jax.lax.stop_gradient(scale)
+    bias = jax.lax.stop_gradient(bias)
+    return jax.nn.relu(
+        _conv(x, weight, padding, stride) * scale.astype(x.dtype)
+        + bias.astype(x.dtype))

@@ -1,0 +1,3 @@
+from apex_tpu.contrib.groupbn.batch_norm import GroupBatchNorm2d  # noqa: F401
+
+__all__ = ["GroupBatchNorm2d"]

@@ -1,0 +1,19 @@
+from apex_tpu.contrib.multihead_attn.multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+    mask_softmax_dropout,
+)
+
+# reference functional-variant names (`fast_*` picked CUDA kernels; one
+# XLA/Pallas path serves all)
+self_attn_func = SelfMultiheadAttn
+fast_self_attn_func = SelfMultiheadAttn
+encdec_attn_func = EncdecMultiheadAttn
+fast_encdec_attn_func = EncdecMultiheadAttn
+mask_softmax_dropout_func = mask_softmax_dropout
+
+__all__ = [
+    "SelfMultiheadAttn",
+    "EncdecMultiheadAttn",
+    "mask_softmax_dropout",
+]

@@ -1,0 +1,313 @@
+"""Fused multi-head attention modules — self and encoder-decoder.
+
+Reference: ``apex/contrib/multihead_attn/`` (~9k LoC CUDA incl.
+``softmax.cuh``, CUTLASS strided-batched GEMMs; 8 autograd-function
+variants + ``SelfMultiheadAttn``/``EncdecMultiheadAttn`` modules). The
+variants multiplex: bias on the projections, a key-padding or additive
+mask, fused pre-LayerNorm + residual dropout-add (``*_norm_add_func``),
+Philox attention dropout, and separate-vs-packed QKV parameters.
+
+TPU-native: the projections are XLA GEMMs (epilogue fusion is the
+cublasLt analogue); the attention core dispatches to the Pallas flash
+kernel (in-kernel hash dropout — the Philox analogue) when the mask is a
+key-padding/causal one, and to an explicit fused softmax path for additive
+masks; ``include_norm_add`` uses the fused LayerNorm with the residual
+dropout-add epilogue. Layout is the reference's Time x Batch x Channel
+(``[s, b, h]``).
+
+Functional-parameter spelling: ``module.init(key)`` returns the param
+dict, ``module(params, ...)`` applies — the JAX analogue of the torch
+``nn.Module`` parameter registry.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm
+
+Pytree = Any
+
+
+def mask_softmax_dropout(
+    scores: jax.Array,  # [b, n, sq, sk] raw (already scaled) scores
+    mask: Optional[jax.Array] = None,  # bool [b, sk] pad / additive [b,n,sq,sk]
+    mask_additive: bool = False,
+    dropout_prob: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The ``mask_softmax_dropout_func`` composition
+    (``contrib/multihead_attn/mask_softmax_dropout_func.py``): mask ->
+    softmax -> dropout on the probability matrix, fp32 softmax."""
+    s = scores.astype(jnp.float32)
+    if mask is not None:
+        if mask_additive:
+            m = mask.astype(jnp.float32)
+            if m.ndim == 2:  # additive key-padding [b, sk] -> [b, 1, 1, sk]
+                m = m[:, None, None, :]
+            s = s + m
+        else:
+            # key-padding: True/1 = masked out (reference convention)
+            s = jnp.where(mask[:, None, None, :] != 0, -1e30, s)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_prob > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_prob > 0 requires dropout_key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_prob, p.shape)
+        p = p * keep / (1.0 - dropout_prob)
+    return p.astype(scores.dtype)
+
+
+def _split_heads(x, n):  # [s, b, h] -> [b, n, s, d]
+    s, b, h = x.shape
+    return x.reshape(s, b, n, h // n).transpose(1, 2, 0, 3)
+
+
+def _merge_heads(x):  # [b, n, s, d] -> [s, b, h]
+    b, n, s, d = x.shape
+    return x.transpose(2, 0, 1, 3).reshape(s, b, n * d)
+
+
+def _attend(q, k, v, num_heads, scaling, key_padding_mask, attn_mask,
+            mask_additive, dropout_prob, dropout_key):
+    """Attention core on [s, b, h] tensors; picks flash vs explicit path."""
+    qh = _split_heads(q, num_heads)
+    kh = _split_heads(k, num_heads)
+    vh = _split_heads(v, num_heads)
+
+    flash_ok = not mask_additive and attn_mask is None
+    if flash_ok:
+        kv_mask = None
+        if key_padding_mask is not None:
+            kv_mask = key_padding_mask == 0  # flash: True = attend
+        seed = None
+        if dropout_prob > 0.0:
+            if dropout_key is None:
+                raise ValueError("dropout requires dropout_key")
+            seed = jax.random.randint(
+                dropout_key, (), -(2 ** 31), 2 ** 31 - 1, jnp.int32)
+        ctx = flash_attention(
+            qh, kh, vh, kv_mask=kv_mask, scale=scaling,
+            dropout_p=dropout_prob, dropout_seed=seed,
+        )
+    else:
+        scores = jnp.einsum(
+            "bnqd,bnkd->bnqk", qh, kh, preferred_element_type=jnp.float32
+        ) * scaling
+        mask = attn_mask if attn_mask is not None else key_padding_mask
+        p = mask_softmax_dropout(
+            scores, mask, mask_additive or attn_mask is not None,
+            dropout_prob, dropout_key,
+        )
+        ctx = jnp.einsum(
+            "bnqk,bnkd->bnqd", p.astype(vh.dtype), vh,
+            preferred_element_type=jnp.float32,
+        ).astype(qh.dtype)
+    return _merge_heads(ctx)
+
+
+class SelfMultiheadAttn:
+    """Reference ``SelfMultiheadAttn`` (``self_multihead_attn.py:22+``).
+
+    Options mirrored: ``bias``, ``include_norm_add`` (pre-LN + residual
+    dropout-add), ``separate_qkv_params``, ``mask_additive``. ``impl`` is
+    accepted for parity ("fast"/"default" pick CUDA kernels; here one
+    XLA/Pallas path serves both).
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
+                 include_norm_add=False, impl="fast",
+                 separate_qkv_params=False, mask_additive=False):
+        del impl
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        if mask_additive and include_norm_add:
+            raise ValueError(
+                "additive mask not supported with layer norm (reference "
+                "assert, self_multihead_attn.py:52)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.bias = bias
+        self.include_norm_add = include_norm_add
+        self.separate_qkv_params = separate_qkv_params
+        self.mask_additive = mask_additive
+        self.scaling = (embed_dim // num_heads) ** -0.5
+
+    def init(self, key: jax.Array) -> Pytree:
+        h = self.embed_dim
+        ks = jax.random.split(key, 5)
+        xavier = jax.nn.initializers.glorot_uniform()
+        p: dict = {}
+        if self.separate_qkv_params:
+            p["q_weight"] = xavier(ks[0], (h, h))
+            p["k_weight"] = xavier(ks[1], (h, h))
+            p["v_weight"] = xavier(ks[2], (h, h))
+        else:
+            # gain sqrt(2): the [3h, h] matrix initialised like [h, h]
+            # (reference reset_parameters comment)
+            p["in_proj_weight"] = xavier(ks[0], (3 * h, h)) * math.sqrt(2)
+        p["out_proj_weight"] = xavier(ks[3], (h, h))
+        if self.bias:
+            if self.separate_qkv_params:
+                p["q_bias"] = jnp.zeros((h,))
+                p["k_bias"] = jnp.zeros((h,))
+                p["v_bias"] = jnp.zeros((h,))
+            else:
+                p["in_proj_bias"] = jnp.zeros((3 * h,))
+            p["out_proj_bias"] = jnp.zeros((h,))
+        if self.include_norm_add:
+            p["lyr_nrm_gamma_weights"] = jnp.ones((h,))
+            p["lyr_nrm_beta_weights"] = jnp.zeros((h,))
+        return p
+
+    def _in_proj(self, params):
+        h = self.embed_dim
+        n, d = self.num_heads, self.embed_dim // self.num_heads
+        if self.separate_qkv_params:
+            # interleave per head: [n, 3, d, h] -> [3h, h] (reference
+            # forward's cat/view dance)
+            w = jnp.concatenate([
+                params["q_weight"].reshape(n, 1, d, h),
+                params["k_weight"].reshape(n, 1, d, h),
+                params["v_weight"].reshape(n, 1, d, h),
+            ], axis=1).reshape(3 * h, h)
+            b = None
+            if self.bias:
+                b = jnp.concatenate([
+                    params["q_bias"].reshape(n, 1, d),
+                    params["k_bias"].reshape(n, 1, d),
+                    params["v_bias"].reshape(n, 1, d),
+                ], axis=1).reshape(3 * h)
+            return w, b
+        return params["in_proj_weight"], params.get("in_proj_bias")
+
+    # ---- shared prologue/epilogue (used by Encdec too) -------------------
+    def _pre_ln(self, params, query):
+        if not self.include_norm_add:
+            return query
+        return layer_norm(
+            query.astype(jnp.float32),
+            params["lyr_nrm_gamma_weights"],
+            params["lyr_nrm_beta_weights"],
+        ).astype(query.dtype)
+
+    def _dropout_keys(self, is_training, dropout_key):
+        drop_p = self.dropout if is_training else 0.0
+        k_attn = None
+        if drop_p > 0.0:
+            if dropout_key is None:
+                raise ValueError("training dropout requires dropout_key")
+            dropout_key, k_attn = jax.random.split(dropout_key)
+        return drop_p, k_attn, dropout_key
+
+    def _epilogue(self, params, ctx, residual, drop_p, dropout_key):
+        out = jnp.einsum(
+            "sbh,oh->sbo", ctx, params["out_proj_weight"].astype(ctx.dtype))
+        if self.bias:
+            out = out + params["out_proj_bias"].astype(out.dtype)
+        if self.include_norm_add:
+            # residual dropout-add (reference jit_dropout_add)
+            if drop_p > 0.0:
+                keep = jax.random.bernoulli(
+                    dropout_key, 1.0 - drop_p, out.shape)
+                out = out * keep / (1.0 - drop_p)
+            out = residual + out
+        return out
+
+    @staticmethod
+    def _check_masks(key_padding_mask, attn_mask):
+        if key_padding_mask is not None and attn_mask is not None:
+            raise ValueError(
+                "attn_mask and key_padding_mask should not be both defined")
+
+    def __call__(self, params, query, key=None, value=None,
+                 key_padding_mask=None, need_weights=False, attn_mask=None,
+                 is_training=True, dropout_key=None):
+        """query [s, b, h]; self-attention ignores key/value (parity args).
+        ``key_padding_mask`` [b, s]: 1 = masked out, or additive values
+        when ``mask_additive``; ``attn_mask`` additive
+        [b?, n?, sq, sk]-broadcastable."""
+        del key, value, need_weights
+        self._check_masks(key_padding_mask, attn_mask)
+        h = self.embed_dim
+        residual = query
+        x = self._pre_ln(params, query)
+
+        w, b = self._in_proj(params)
+        qkv = jnp.einsum("sbh,oh->sbo", x, w.astype(x.dtype))
+        if b is not None:
+            qkv = qkv + b.astype(qkv.dtype)
+        # per-head interleaved packing: [s, b, n, 3, d]
+        n, d = self.num_heads, h // self.num_heads
+        s_len, bsz = qkv.shape[:2]
+        qkv = qkv.reshape(s_len, bsz, n, 3, d)
+        q, k, v = (qkv[..., i, :].reshape(s_len, bsz, h) for i in range(3))
+
+        drop_p, k_attn, dropout_key = self._dropout_keys(
+            is_training, dropout_key)
+        ctx = _attend(q, k, v, n, self.scaling, key_padding_mask, attn_mask,
+                      self.mask_additive, drop_p, k_attn)
+        out = self._epilogue(params, ctx, residual, drop_p, dropout_key)
+        return out, None  # (attn_output, attn_weights=None) parity
+
+
+class EncdecMultiheadAttn(SelfMultiheadAttn):
+    """Reference ``EncdecMultiheadAttn`` (``encdec_multihead_attn.py``):
+    query from the decoder, key/value from the encoder output — a
+    ``[h, h]`` q projection and a packed ``[2h, h]`` kv projection."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
+                 include_norm_add=False, impl="fast"):
+        super().__init__(embed_dim, num_heads, dropout=dropout, bias=bias,
+                         include_norm_add=include_norm_add, impl=impl)
+
+    def init(self, key: jax.Array) -> Pytree:
+        h = self.embed_dim
+        ks = jax.random.split(key, 4)
+        xavier = jax.nn.initializers.glorot_uniform()
+        p = {
+            "q_weight": xavier(ks[0], (h, h)),
+            "kv_weight": xavier(ks[1], (2 * h, h)) * math.sqrt(1.5),
+            "out_proj_weight": xavier(ks[2], (h, h)),
+        }
+        if self.bias:
+            p["q_bias"] = jnp.zeros((h,))
+            p["kv_bias"] = jnp.zeros((2 * h,))
+            p["out_proj_bias"] = jnp.zeros((h,))
+        if self.include_norm_add:
+            p["lyr_nrm_gamma_weights"] = jnp.ones((h,))
+            p["lyr_nrm_beta_weights"] = jnp.zeros((h,))
+        return p
+
+    def __call__(self, params, query, key, value=None, key_padding_mask=None,
+                 need_weights=False, attn_mask=None, is_training=True,
+                 dropout_key=None):
+        del value, need_weights
+        self._check_masks(key_padding_mask, attn_mask)
+        h = self.embed_dim
+        n, d = self.num_heads, h // self.num_heads
+        residual = query
+        x = self._pre_ln(params, query)
+
+        q = jnp.einsum("sbh,oh->sbo", x, params["q_weight"].astype(x.dtype))
+        kv = jnp.einsum(
+            "sbh,oh->sbo", key, params["kv_weight"].astype(key.dtype))
+        if self.bias:
+            q = q + params["q_bias"].astype(q.dtype)
+            kv = kv + params["kv_bias"].astype(kv.dtype)
+        sk, bsz = kv.shape[:2]
+        kv = kv.reshape(sk, bsz, n, 2, d)
+        k = kv[..., 0, :].reshape(sk, bsz, h)
+        v = kv[..., 1, :].reshape(sk, bsz, h)
+
+        drop_p, k_attn, dropout_key = self._dropout_keys(
+            is_training, dropout_key)
+        ctx = _attend(q, k, v, n, self.scaling, key_padding_mask, attn_mask,
+                      False, drop_p, k_attn)
+        out = self._epilogue(params, ctx, residual, drop_p, dropout_key)
+        return out, None

@@ -1,0 +1,6 @@
+from apex_tpu.contrib.openfold.fused_adam_swa import (  # noqa: F401
+    AdamMathType,
+    FusedAdamSWA,
+)
+
+__all__ = ["FusedAdamSWA", "AdamMathType"]

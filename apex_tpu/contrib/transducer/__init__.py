@@ -1,0 +1,13 @@
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+__all__ = [
+    "TransducerJoint",
+    "TransducerLoss",
+    "transducer_joint",
+    "transducer_loss",
+]

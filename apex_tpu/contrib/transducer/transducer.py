@@ -1,0 +1,275 @@
+"""Transducer (RNN-T) joint and loss — TPU-native.
+
+Reference: ``apex/contrib/transducer/transducer.py:5-127`` over
+``csrc/transducer/`` (~2k LoC CUDA): a fused joint (broadcast add +
+ReLU/dropout epilogue + optional packed output that drops the don't-care
+(t, u) region) and the RNN-T loss (alpha/beta dynamic program with a
+softmax-fused backward).
+
+TPU-native design:
+
+- the joint is the broadcast add with fused epilogues (XLA fuses the
+  elementwise chain); packing is a scatter by precomputed destination
+  indices — static ``packed_batch`` keeps it jit-compatible, exactly the
+  reference's contract (caller supplies ``batch_offset``/``packed_batch``);
+- the loss runs the alpha recursion as a ``lax.scan`` over time whose body
+  solves the label-dimension first-order recurrence in the log semiring by
+  an inner scan; backward is JAX autodiff through the DP (the
+  ``fuse_softmax_backward`` fusion is what XLA does to the
+  log_softmax+DP transpose anyway — the flag is accepted for parity).
+
+Losses are per-utterance (the reference returns the loss vector).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# joint
+# ---------------------------------------------------------------------------
+
+
+def transducer_joint(
+    f: jax.Array,  # [B, T, H]
+    g: jax.Array,  # [B, U, H]
+    f_len: jax.Array,  # [B]
+    g_len: jax.Array,  # [B]
+    *,
+    pack_output: bool = False,
+    relu: bool = False,
+    dropout_prob: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
+    batch_offset: Optional[jax.Array] = None,
+    packed_batch: int = 0,
+    return_mask: bool = False,
+):
+    """``out[b, t, u] = f[b, t] + g[b, u]`` with optional fused ReLU /
+    dropout epilogue, optionally packed to ``[packed_batch, H]`` with the
+    don't-care region (t >= f_len or u >= g_len) removed.
+
+    ``batch_offset = cumsum(f_len * g_len)`` (the reference's convention)
+    and a static ``packed_batch`` are required for packing.
+    ``return_mask=True`` additionally returns the fused ReLU/dropout
+    keep-mask (the reference's ``probe_mask``, as a VALUE — a mutated
+    Python list would go stale under jit).
+    """
+    b, t, h = f.shape
+    u = g.shape[1]
+    out = f[:, :, None, :] + g[:, None, :, :]  # [B, T, U, H]
+
+    mask = None
+    if relu:
+        mask = (out > 0).astype(out.dtype)
+        out = out * mask
+    if dropout_prob > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_prob > 0 requires dropout_key")
+        keep = jax.random.bernoulli(
+            dropout_key, 1.0 - dropout_prob, out.shape
+        ).astype(out.dtype)
+        out = out * keep / (1.0 - dropout_prob)
+        mask = keep if mask is None else mask * keep
+    if not pack_output:
+        return (out, mask) if return_mask else out
+
+    if batch_offset is None or packed_batch == 0:
+        raise ValueError(
+            "batch_offset and packed_batch are required when packing"
+        )
+    # destination index of (b, t, u): start_b + t * g_len[b] + u for the
+    # valid region; invalid entries scatter to index packed_batch (dropped)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), batch_offset.dtype), batch_offset[:-1]]
+    )
+    tt = jnp.arange(t)[None, :, None]
+    uu = jnp.arange(u)[None, None, :]
+    valid = (tt < f_len[:, None, None]) & (uu < g_len[:, None, None])
+    dest = starts[:, None, None] + tt * g_len[:, None, None] + uu
+    dest = jnp.where(valid, dest, packed_batch)  # [B, T, U]
+    packed = jnp.zeros((packed_batch + 1, h), out.dtype)
+    packed = packed.at[dest.reshape(-1)].set(
+        out.reshape(-1, h), mode="drop"
+    )
+    return (packed[:packed_batch], mask) if return_mask else packed[:packed_batch]
+
+
+class TransducerJoint:
+    """Module parity with the reference ``TransducerJoint`` (``:5-67``).
+
+    ``opt``/``fwd_tile_size`` pick CUDA tilings with no XLA analogue;
+    accepted and ignored. Dropout is functional: pass ``dropout_key`` per
+    call (only applied when ``training=True``, like the reference).
+
+    ``probe_mask``: ``self.mask_probe`` holds ONLY the latest call's mask
+    and is valid for eager calls only — under ``jit`` the Python side
+    effect runs at trace time (a stale tracer); use
+    ``transducer_joint(..., return_mask=True)`` there.
+    """
+
+    def __init__(self, pack_output=False, relu=False, dropout=False, opt=1,
+                 fwd_tile_size=4, dropout_prob=0.0, probe_mask=False):
+        del opt, fwd_tile_size
+        self.pack_output = pack_output
+        self.relu = relu
+        self.dropout = dropout
+        self.dropout_prob = dropout_prob
+        masked = relu or dropout
+        self.mask_probe: Optional[List] = [] if masked and probe_mask else None
+
+    def __call__(self, f, g, f_len, g_len, batch_offset=None, packed_batch=0,
+                 *, training=True, dropout_key=None):
+        use_dropout = self.dropout and training
+        probe = self.mask_probe is not None
+        out = transducer_joint(
+            f, g, f_len, g_len,
+            pack_output=self.pack_output,
+            relu=self.relu,
+            dropout_prob=self.dropout_prob if use_dropout else 0.0,
+            dropout_key=dropout_key,
+            batch_offset=batch_offset,
+            packed_batch=packed_batch,
+            return_mask=probe,
+        )
+        if probe:
+            out, mask = out
+            self.mask_probe.clear()
+            if mask is not None:
+                self.mask_probe.append(mask)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# loss
+# ---------------------------------------------------------------------------
+
+
+def transducer_loss(
+    x: jax.Array,  # [B, T, U, V] joint logits (U = max y_len + 1)
+    label: jax.Array,  # [B, U-1] int labels
+    f_len: jax.Array,  # [B] time lengths
+    y_len: jax.Array,  # [B] label lengths
+    blank_idx: int,
+    *,
+    fuse_softmax_backward: bool = True,  # parity; XLA fuses the transpose
+    return_alphas: bool = False,
+):
+    """Per-utterance RNN-T negative log-likelihood (Graves 2012).
+
+    ``alpha[t, u] = logsumexp(alpha[t-1, u] + blank(t-1, u),
+                              alpha[t, u-1] + emit(t, u-1))``
+    with ``loss = -(alpha[f_len-1, y_len] + blank(f_len-1, y_len))``.
+    Backward is autodiff through the DP (the occupancy-probability
+    gradients the reference kernel computes analytically).
+    """
+    del fuse_softmax_backward
+    b, t_max, u_max, v = x.shape
+    logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    lp_blank = logp[..., blank_idx]  # [B, T, U]
+    # emit prob of label[u] at position (t, u): gather along vocab
+    lab = jnp.pad(label, ((0, 0), (0, u_max - label.shape[1])))  # [B, U]
+    lp_emit = jnp.take_along_axis(
+        logp, lab[:, None, :, None], axis=-1
+    )[..., 0]  # [B, T, U]
+    # positions u >= y_len cannot emit (only blank continues)
+    uu = jnp.arange(u_max)[None, None, :]
+    lp_emit = jnp.where(uu < y_len[:, None, None], lp_emit, _NEG_INF)
+
+    def time_step(alpha_prev, lps):
+        lpb_prev, lpe_t = lps  # blank logp at t-1 [B,U]; emit logp at t [B,U]
+        from_below = alpha_prev + lpb_prev  # advance time with a blank
+
+        def u_step(carry, xs):
+            fb, lpe_prev = xs  # [B], [B]
+            a = jnp.logaddexp(fb, carry + lpe_prev)
+            return a, a
+
+        # u = 0 entry: only the blank path
+        a0 = from_below[:, 0]
+        _, rest = jax.lax.scan(
+            u_step, a0,
+            (from_below[:, 1:].T, lpe_t[:, :-1].T),
+        )
+        alpha_t = jnp.concatenate([a0[:, None], rest.T], axis=1)
+        return alpha_t, alpha_t
+
+    # alpha[0]: along u only emissions at t=0
+    def init_u(carry, lpe_prev):
+        a = carry + lpe_prev
+        return a, a
+
+    a00 = jnp.zeros((b,), jnp.float32)
+    _, a0_rest = jax.lax.scan(init_u, a00, lp_emit[:, 0, :-1].T)
+    alpha0 = jnp.concatenate([a00[:, None], a0_rest.T], axis=1)  # [B, U]
+
+    _, alphas = jax.lax.scan(
+        time_step, alpha0,
+        (lp_blank[:, :-1].transpose(1, 0, 2), lp_emit[:, 1:].transpose(1, 0, 2)),
+    )
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, U]
+
+
+    # terminal: alpha[f_len-1, y_len] + blank(f_len-1, y_len)
+    bidx = jnp.arange(b)
+    t_last = jnp.clip(f_len - 1, 0, t_max - 1)
+    u_last = jnp.clip(y_len, 0, u_max - 1)
+    a_term = alphas[t_last, bidx, u_last]
+    lp_term = lp_blank[bidx, t_last, u_last]
+    losses = -(a_term + lp_term)
+    if return_alphas:
+        return losses, alphas.transpose(1, 0, 2)  # alphas [B, T, U]
+    return losses
+
+
+class TransducerLoss:
+    """Module parity with the reference ``TransducerLoss`` (``:70-127``).
+    ``packed_input`` takes ``x`` as ``[total, V]`` with
+    ``batch_offset = cumsum(f_len * (y_len + 1))`` and ``max_f_len``
+    (unpacked internally; don't-care positions never reach the DP)."""
+
+    def __init__(self, fuse_softmax_backward=True, opt=1, packed_input=False):
+        del opt
+        self.fuse_softmax_backward = fuse_softmax_backward
+        self.packed_input = packed_input
+
+    def __call__(self, x, label, f_len, y_len, blank_idx, batch_offset=None,
+                 max_f_len=None, debug_list=None):
+        if self.packed_input:
+            if batch_offset is None or max_f_len is None:
+                raise ValueError(
+                    "batch_offset and max_f_len are required for packed input"
+                )
+            b = f_len.shape[0]
+            u_max = label.shape[1] + 1
+            v = x.shape[-1]
+            starts = jnp.concatenate(
+                [jnp.zeros((1,), batch_offset.dtype), batch_offset[:-1]]
+            )
+            tt = jnp.arange(max_f_len)[None, :, None]
+            uu = jnp.arange(u_max)[None, None, :]
+            src = starts[:, None, None] + tt * (y_len + 1)[:, None, None] + uu
+            valid = (tt < f_len[:, None, None]) & (
+                uu <= y_len[:, None, None]
+            )
+            src = jnp.where(valid, src, 0)
+            dense = x[src.reshape(-1)].reshape(b, max_f_len, u_max, v)
+            dense = jnp.where(valid[..., None], dense, 0.0)
+            x = dense
+        out = transducer_loss(
+            x, label, f_len, y_len, blank_idx,
+            fuse_softmax_backward=self.fuse_softmax_backward,
+            return_alphas=debug_list is not None,
+        )
+        if debug_list is not None:
+            losses, alphas = out
+            # latest call only (a growing list would retain every step's
+            # alphas; under jit prefer transducer_loss(return_alphas=True))
+            debug_list.clear()
+            debug_list.append(alphas)
+            return losses
+        return out

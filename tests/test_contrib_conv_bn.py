@@ -1,0 +1,194 @@
+"""Tests: contrib.conv_bias_relu and contrib.groupbn/cudnn_gbn.
+
+Each vs a torch reference (the reference suites'
+`apex/contrib/test/{conv_bias_relu,groupbn,cudnn_gbn}` idiom).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.conv_bias_relu import (
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)
+from apex_tpu.contrib.cudnn_gbn import GroupBatchNorm2d
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+
+def _conv_inputs(key=0, n=2, h=8, w=8, cin=4, cout=6, k=3):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    x = jax.random.normal(ks[0], (n, h, w, cin))
+    weight = jax.random.normal(ks[1], (k, k, cin, cout)) * 0.3
+    bias = jax.random.normal(ks[2], (cout,)) * 0.1
+    return x, weight, bias
+
+
+def _torch_conv(x, weight, padding, stride):
+    tx = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)
+    tw = torch.tensor(np.asarray(weight)).permute(3, 2, 0, 1)  # HWIO->OIHW
+    return torch.nn.functional.conv2d(tx, tw, padding=padding, stride=stride)
+
+
+@pytest.mark.parametrize("padding,stride", [(1, 1), (1, 2), (0, 1)])
+def test_conv_bias_relu_vs_torch(padding, stride):
+    x, weight, bias = _conv_inputs()
+    out = ConvBiasReLU(x, weight, bias, padding, stride)
+    ref = torch.relu(_torch_conv(x, weight, padding, stride)
+                     + torch.tensor(np.asarray(bias)).view(1, -1, 1, 1))
+    np.testing.assert_allclose(
+        np.asarray(out), ref.permute(0, 2, 3, 1).numpy(), atol=1e-5)
+
+
+def test_conv_bias_and_mask_relu():
+    x, weight, bias = _conv_inputs(1)
+    out_nb = ConvBias(x, weight, bias, 1, 1)
+    ref = (_torch_conv(x, weight, 1, 1)
+           + torch.tensor(np.asarray(bias)).view(1, -1, 1, 1))
+    np.testing.assert_allclose(
+        np.asarray(out_nb), ref.permute(0, 2, 3, 1).numpy(), atol=1e-5)
+
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), out_nb.shape) > 0.5)
+    out_m = ConvBiasMaskReLU(x, weight, bias, mask, 1, 1)
+    ref_m = np.maximum(np.asarray(out_nb) * np.asarray(mask, np.float32), 0.0)
+    np.testing.assert_allclose(np.asarray(out_m), ref_m, atol=1e-5)
+
+
+def test_conv_frozen_scale_bias_relu_stops_gradients():
+    x, weight, bias = _conv_inputs(3)
+    scale = jnp.ones((weight.shape[-1],)) * 1.5
+
+    def f(weight, scale, bias):
+        return jnp.sum(ConvFrozenScaleBiasReLU(x, weight, scale, bias, 1, 1))
+
+    gw, gs, gb = jax.grad(f, argnums=(0, 1, 2))(weight, scale, bias)
+    assert np.abs(np.asarray(gw)).max() > 0  # conv weight trains
+    assert np.abs(np.asarray(gs)).max() == 0.0  # frozen
+    assert np.abs(np.asarray(gb)).max() == 0.0  # frozen
+
+
+def test_groupbn_single_group_matches_torch():
+    n, h, w, c = 4, 5, 5, 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, h, w, c))
+    bn = BatchNorm2d_NHWC(c)
+    params, state = bn.init()
+    y, new_state = bn.apply(params, state, x, training=True)
+
+    tbn = torch.nn.BatchNorm2d(c, momentum=0.1, eps=1e-5)
+    tx = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)
+    ty = tbn(tx).detach().permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(y), ty, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(new_state["running_mean"]),
+        tbn.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(new_state["running_var"]),
+        tbn.running_var.numpy(), atol=1e-4)
+
+
+def test_groupbn_addrelu_epilogue():
+    c = 8
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 4, c))
+    z = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 4, c))
+    bn = BatchNorm2d_NHWC(c)
+    params, state = bn.init()
+    y_plain, _ = bn.apply(params, state, x, training=True)
+    y_addrelu, _ = bn.apply(params, state, x, z, training=True)
+    np.testing.assert_allclose(
+        np.asarray(y_addrelu),
+        np.maximum(np.asarray(y_plain) + np.asarray(z), 0.0), atol=1e-5)
+
+
+def test_groupbn_group_sync_equals_global_bn():
+    """bn_group=4 over the mesh axis: per-shard BN with group sync must
+    equal single-device BN on the concatenated batch (the reference's
+    whole point: small per-GPU batches, full-group statistics)."""
+    G = 4
+    n, h, w, c = 8, 4, 4, 8  # batch sharded into 4 shards of 2
+    x = jax.random.normal(jax.random.PRNGKey(3), (n, h, w, c))
+    bn = BatchNorm2d_NHWC(c, bn_group=G, axis_name="bn_group")
+    params, state = bn.init()
+
+    mesh = Mesh(np.array(jax.devices()[:G]), ("bn_group",))
+    y, new_state = jax.shard_map(
+        lambda p, s, x: bn.apply(p, s, x, training=True),
+        mesh=mesh, in_specs=(P(), P(), P("bn_group")),
+        out_specs=(P("bn_group"), P()), check_vma=False,
+    )(params, state, x)
+
+    bn1 = BatchNorm2d_NHWC(c)
+    y_ref, state_ref = bn1.apply(params, state, x, training=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(new_state["running_mean"]),
+        np.asarray(state_ref["running_mean"]), atol=1e-5)
+
+
+def test_cudnn_gbn_alias():
+    gbn = GroupBatchNorm2d(8, group_size=2)
+    assert gbn.bn_group == 2
+    with pytest.raises(NotImplementedError):
+        GroupBatchNorm2d(8, group_size=2, affine=False)
+
+
+# ------------------------------------------------------------ fused_adam_swa
+
+
+def test_fused_adam_swa_matches_torch_adam_and_swa_math():
+    """PyTorchAdam mode vs torch.optim.Adam state-by-state, and the SWA
+    EMA vs hand math (reference `apex/contrib/test/openfold_triton/
+    test_fused_adam_swa.py` idiom)."""
+    from apex_tpu.contrib.openfold import AdamMathType, FusedAdamSWA
+
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(4, 5), jnp.float32)}
+    compute = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params)
+    swa = jax.tree_util.tree_map(lambda p: p, params)
+    opt = FusedAdamSWA(swa_decay_rate=0.9, lr=1e-2, weight_decay=0.01,
+                       adam_math_mode=AdamMathType.PyTorchAdam)
+    state = opt.init(params)
+
+    tp = torch.tensor(np.asarray(params["w"]), requires_grad=True)
+    topt = torch.optim.Adam([tp], lr=1e-2, weight_decay=0.01)
+
+    rng = np.random.RandomState(1)
+    swa_ref = np.asarray(params["w"]).copy()
+    for i in range(5):
+        g = rng.randn(4, 5).astype(np.float32)
+        params, compute, swa, state = opt.step(
+            {"w": jnp.asarray(g)}, state, params, compute, swa)
+        tp.grad = torch.tensor(g)
+        topt.step()
+        np.testing.assert_allclose(
+            np.asarray(params["w"]), tp.detach().numpy(), atol=1e-6,
+            err_msg=f"step {i}")
+        if i == 0:
+            swa_ref = tp.detach().numpy().copy()
+        else:
+            swa_ref = swa_ref + (1 - 0.9) * (tp.detach().numpy() - swa_ref)
+        np.testing.assert_allclose(
+            np.asarray(swa["w"]), swa_ref, atol=1e-6, err_msg=f"swa {i}")
+    # compute copy tracks the master in bf16
+    np.testing.assert_allclose(
+        np.asarray(compute["w"], np.float32),
+        np.asarray(params["w"].astype(jnp.bfloat16), np.float32))
+
+
+def test_fused_adam_swa_apexw_mode_differs():
+    from apex_tpu.contrib.openfold import AdamMathType, FusedAdamSWA
+
+    params = {"w": jnp.ones((3,))}
+    g = {"w": jnp.full((3,), 0.5)}
+    outs = {}
+    for mode in (AdamMathType.PyTorchAdam, AdamMathType.ApexAdamW):
+        opt = FusedAdamSWA(swa_decay_rate=0.9, lr=1e-2, weight_decay=0.1,
+                           adam_math_mode=mode)
+        st = opt.init(params)
+        p, _, _, _ = opt.step(
+            g, st, params, params, params)
+        outs[mode] = np.asarray(p["w"])
+    assert not np.allclose(outs[AdamMathType.PyTorchAdam],
+                           outs[AdamMathType.ApexAdamW])

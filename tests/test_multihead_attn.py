@@ -1,0 +1,188 @@
+"""contrib.multihead_attn tests — vs an explicit torch-style reference.
+
+Mirrors the reference suite (`apex/contrib/test/multihead_attn/`): the
+fused module against a plain composition of the same math, across the
+variant matrix (bias, separate qkv, padding mask, additive mask,
+norm-add residual, encdec).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+    mask_softmax_dropout,
+)
+
+S, B, H, N = 16, 2, 32, 4
+
+
+def _x(key=0, s=S):
+    return jax.random.normal(jax.random.PRNGKey(key), (s, B, H)) * 0.5
+
+
+def _ref_self_attn(params, x, module, key_padding_mask=None, attn_mask=None):
+    """Plain-composition reference for SelfMultiheadAttn (no dropout)."""
+    h, n = module.embed_dim, module.num_heads
+    d = h // n
+    if module.include_norm_add:
+        residual = x
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        xn = (x - mu) * jax.lax.rsqrt(var + 1e-5)
+        x = xn * params["lyr_nrm_gamma_weights"] + params["lyr_nrm_beta_weights"]
+    w, b = module._in_proj(params)
+    qkv = jnp.einsum("sbh,oh->sbo", x, w)
+    if b is not None:
+        qkv = qkv + b
+    s_len = x.shape[0]
+    qkv = qkv.reshape(s_len, B, n, 3, d)
+    q = qkv[..., 0, :].transpose(1, 2, 0, 3)  # [b, n, s, d]
+    k = qkv[..., 1, :].transpose(1, 2, 0, 3)
+    v = qkv[..., 2, :].transpose(1, 2, 0, 3)
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) * module.scaling
+    if key_padding_mask is not None:
+        scores = jnp.where(
+            key_padding_mask[:, None, None, :] != 0, -1e30, scores)
+    if attn_mask is not None:
+        scores = scores + attn_mask
+    p = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bnqk,bnkd->bnqd", p, v)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(s_len, B, h)
+    out = jnp.einsum("sbh,oh->sbo", ctx, params["out_proj_weight"])
+    if module.bias:
+        out = out + params["out_proj_bias"]
+    if module.include_norm_add:
+        out = residual + out
+    return out
+
+
+@pytest.mark.parametrize("bias", [False, True])
+@pytest.mark.parametrize("separate", [False, True])
+def test_self_attn_matches_reference(bias, separate):
+    m = SelfMultiheadAttn(H, N, bias=bias, separate_qkv_params=separate)
+    params = m.init(jax.random.PRNGKey(0))
+    x = _x()
+    out, _ = m(params, x, is_training=False)
+    ref = _ref_self_attn(params, x, m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_self_attn_key_padding_mask():
+    m = SelfMultiheadAttn(H, N, bias=True)
+    params = m.init(jax.random.PRNGKey(1))
+    x = _x(1)
+    kpm = jnp.zeros((B, S), jnp.int32).at[:, -5:].set(1)  # 1 = masked out
+    out, _ = m(params, x, key_padding_mask=kpm, is_training=False)
+    ref = _ref_self_attn(params, x, m, key_padding_mask=kpm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # masked keys truly don't contribute: poisoning them changes nothing
+    x2 = x.at[-5:].set(1e3)
+    out2, _ = m(params, x2, key_padding_mask=kpm, is_training=False)
+    np.testing.assert_allclose(
+        np.asarray(out[:-5]), np.asarray(out2[:-5]), atol=2e-4)
+
+
+def test_self_attn_additive_mask():
+    m = SelfMultiheadAttn(H, N, bias=True, mask_additive=True)
+    params = m.init(jax.random.PRNGKey(2))
+    x = _x(2)
+    causal = jnp.where(
+        jnp.triu(jnp.ones((S, S)), k=1) > 0, -1e30, 0.0)[None, None]
+    out, _ = m(params, x, attn_mask=causal, is_training=False)
+    ref = _ref_self_attn(params, x, m, attn_mask=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_self_attn_norm_add_residual():
+    m = SelfMultiheadAttn(H, N, bias=True, include_norm_add=True)
+    params = m.init(jax.random.PRNGKey(3))
+    x = _x(3)
+    out, _ = m(params, x, is_training=False)
+    ref = _ref_self_attn(params, x, m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+    # with zeroed out-proj the block must be the identity (pure residual)
+    p0 = dict(params)
+    p0["out_proj_weight"] = jnp.zeros_like(params["out_proj_weight"])
+    p0["out_proj_bias"] = jnp.zeros_like(params["out_proj_bias"])
+    out0, _ = m(p0, x, is_training=False)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(x), atol=1e-6)
+
+
+def test_self_attn_dropout_determinism_and_effect():
+    m = SelfMultiheadAttn(H, N, bias=True, dropout=0.3)
+    params = m.init(jax.random.PRNGKey(4))
+    x = _x(4)
+    k = jax.random.PRNGKey(7)
+    o1, _ = m(params, x, is_training=True, dropout_key=k)
+    o2, _ = m(params, x, is_training=True, dropout_key=k)
+    o3, _ = m(params, x, is_training=True, dropout_key=jax.random.PRNGKey(8))
+    oe, _ = m(params, x, is_training=False)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert not np.allclose(np.asarray(o1), np.asarray(o3))
+    assert not np.allclose(np.asarray(o1), np.asarray(oe))
+    with pytest.raises(ValueError, match="dropout"):
+        m(params, x, is_training=True)
+
+
+def test_self_attn_grads_finite():
+    m = SelfMultiheadAttn(H, N, bias=True, include_norm_add=True)
+    params = m.init(jax.random.PRNGKey(5))
+    x = _x(5)
+    g = jax.grad(lambda p: jnp.sum(m(p, x, is_training=False)[0] ** 2))(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+        assert np.abs(np.asarray(leaf)).max() > 0
+
+
+def test_encdec_attn():
+    m = EncdecMultiheadAttn(H, N, bias=True)
+    params = m.init(jax.random.PRNGKey(6))
+    q = _x(6, s=8)
+    enc = _x(7, s=S)
+
+    out, _ = m(params, q, enc, is_training=False)
+    assert out.shape == (8, B, H)
+
+    # reference composition
+    h, n, d = H, N, H // N
+    qq = jnp.einsum("sbh,oh->sbo", q, params["q_weight"]) + params["q_bias"]
+    kv = jnp.einsum("sbh,oh->sbo", enc, params["kv_weight"]) + params["kv_bias"]
+    kv = kv.reshape(S, B, n, 2, d)
+    qh = qq.reshape(8, B, n, d).transpose(1, 2, 0, 3)
+    kh = kv[..., 0, :].transpose(1, 2, 0, 3)
+    vh = kv[..., 1, :].transpose(1, 2, 0, 3)
+    p = jax.nn.softmax(
+        jnp.einsum("bnqd,bnkd->bnqk", qh, kh) * m.scaling, axis=-1)
+    ctx = jnp.einsum("bnqk,bnkd->bnqd", p, vh)
+    ref = jnp.einsum(
+        "sbh,oh->sbo",
+        ctx.transpose(2, 0, 1, 3).reshape(8, B, h),
+        params["out_proj_weight"]) + params["out_proj_bias"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_mask_softmax_dropout_func():
+    scores = jax.random.normal(jax.random.PRNGKey(0), (B, N, S, S))
+    kpm = jnp.zeros((B, S), jnp.int32).at[:, -3:].set(1)
+    p = mask_softmax_dropout(scores, kpm)
+    pn = np.asarray(p)
+    np.testing.assert_allclose(pn.sum(-1), 1.0, atol=1e-5)
+    assert np.abs(pn[..., -3:]).max() == 0.0
+
+
+def test_self_attn_additive_2d_key_padding_mask():
+    """mask_additive with a [b, sk] additive key-padding mask (the
+    reference contract for the flag) must broadcast over heads/queries."""
+    m = SelfMultiheadAttn(H, N, bias=True, mask_additive=True)
+    params = m.init(jax.random.PRNGKey(9))
+    x = _x(9)
+    add_kpm = jnp.zeros((B, S)).at[:, -4:].set(-1e30)  # additive padding
+    out, _ = m(params, x, key_padding_mask=add_kpm, is_training=False)
+    # equivalent boolean padding through the non-additive module
+    m2 = SelfMultiheadAttn(H, N, bias=True)
+    kpm = jnp.zeros((B, S), jnp.int32).at[:, -4:].set(1)
+    ref, _ = m2(params, x, key_padding_mask=kpm, is_training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -1,0 +1,207 @@
+"""Transducer (RNN-T) tests — joint and loss vs independent references.
+
+Mirrors the reference suite style (`apex/contrib/test/transducer/`):
+the joint vs explicit broadcast math + packing bookkeeping, the loss vs
+a pure-numpy alpha DP, and gradient sanity.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+
+def _joint_inputs(key=0, b=3, t=5, u=4, h=8):
+    ks = jax.random.split(jax.random.PRNGKey(key), 2)
+    f = jax.random.normal(ks[0], (b, t, h))
+    g = jax.random.normal(ks[1], (b, u, h))
+    f_len = jnp.array([5, 3, 4])
+    g_len = jnp.array([4, 2, 3])
+    return f, g, f_len, g_len
+
+
+def test_joint_unpacked_matches_broadcast():
+    f, g, f_len, g_len = _joint_inputs()
+    out = transducer_joint(f, g, f_len, g_len)
+    ref = np.asarray(f)[:, :, None, :] + np.asarray(g)[:, None, :, :]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_joint_relu_and_mask_probe():
+    f, g, f_len, g_len = _joint_inputs(1)
+    j = TransducerJoint(relu=True, probe_mask=True)
+    out = j(f, g, f_len, g_len)
+    ref = np.maximum(
+        np.asarray(f)[:, :, None, :] + np.asarray(g)[:, None, :, :], 0.0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    assert j.mask_probe and j.mask_probe[0].shape == out.shape
+
+
+def test_joint_packing():
+    f, g, f_len, g_len = _joint_inputs(2)
+    batch_offset = jnp.cumsum(f_len * g_len)
+    packed_batch = int(batch_offset[-1])
+    out = transducer_joint(
+        f, g, f_len, g_len, pack_output=True,
+        batch_offset=batch_offset, packed_batch=packed_batch)
+    assert out.shape == (packed_batch, f.shape[-1])
+    # row for (b, t, u) is f[b, t] + g[b, u], laid out t-major per batch
+    fn, gn = np.asarray(f), np.asarray(g)
+    starts = np.concatenate([[0], np.asarray(batch_offset)[:-1]])
+    for bb in range(f.shape[0]):
+        for tt in range(int(f_len[bb])):
+            for uu in range(int(g_len[bb])):
+                row = starts[bb] + tt * int(g_len[bb]) + uu
+                np.testing.assert_allclose(
+                    np.asarray(out[row]), fn[bb, tt] + gn[bb, uu], rtol=1e-6)
+
+
+def test_joint_dropout_training_only():
+    f, g, f_len, g_len = _joint_inputs(3)
+    j = TransducerJoint(dropout=True, dropout_prob=0.5)
+    out_eval = j(f, g, f_len, g_len, training=False)
+    ref = np.asarray(f)[:, :, None, :] + np.asarray(g)[:, None, :, :]
+    np.testing.assert_allclose(np.asarray(out_eval), ref, rtol=1e-6)
+    out_train = j(f, g, f_len, g_len, training=True,
+                  dropout_key=jax.random.PRNGKey(0))
+    zeros = float((np.asarray(out_train) == 0).mean())
+    assert 0.3 < zeros < 0.7  # ~half dropped
+
+
+def _np_rnnt_loss(x, label, f_len, y_len, blank):
+    """Pure-numpy alpha DP (Graves 2012) per utterance."""
+    x = np.asarray(x, np.float64)
+    logp = x - np.log(np.sum(np.exp(
+        x - x.max(-1, keepdims=True)), -1, keepdims=True)) - x.max(
+            -1, keepdims=True)
+    b = x.shape[0]
+    losses = []
+    for i in range(b):
+        T, U = int(f_len[i]), int(y_len[i]) + 1
+        alpha = np.full((T, U), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(T):
+            for u in range(U):
+                if t == 0 and u == 0:
+                    continue
+                c = []
+                if t > 0:
+                    c.append(alpha[t - 1, u] + logp[i, t - 1, u, blank])
+                if u > 0:
+                    c.append(alpha[t, u - 1]
+                             + logp[i, t, u - 1, label[i, u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(c)
+        losses.append(-(alpha[T - 1, U - 1] + logp[i, T - 1, U - 1, blank]))
+    return np.array(losses)
+
+
+def _loss_inputs(key=0, b=3, t=6, u_max=5, v=7):
+    x = jax.random.normal(jax.random.PRNGKey(key), (b, t, u_max, v)) * 2.0
+    label = jax.random.randint(
+        jax.random.PRNGKey(key + 1), (b, u_max - 1), 0, v - 1)
+    f_len = jnp.array([6, 4, 5])
+    y_len = jnp.array([4, 2, 3])
+    return x, label, f_len, y_len
+
+
+def test_loss_matches_numpy_dp():
+    x, label, f_len, y_len = _loss_inputs()
+    blank = 6
+    ours = transducer_loss(x, label, f_len, y_len, blank)
+    ref = _np_rnnt_loss(x, label, f_len, y_len, blank)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-5)
+
+
+def test_loss_grads_flow_only_into_valid_region():
+    x, label, f_len, y_len = _loss_inputs(4)
+    blank = 6
+    g = jax.grad(lambda x: jnp.sum(
+        transducer_loss(x, label, f_len, y_len, blank)))(x)
+    g = np.asarray(g)
+    assert np.all(np.isfinite(g))
+    # utterance 1 has f_len 4: time steps >= 4 must get zero grad
+    assert np.abs(g[1, 4:]).max() == 0.0
+    assert np.abs(g[1, :4]).max() > 0.0
+    # grads sum to ~0 over vocab for softmax-composed loss? no — but the
+    # total emission mass constraint: d(loss)/dx sums to 0 per (b,t,u)
+    # slot actually holds for log_softmax outputs
+    np.testing.assert_allclose(g.sum(-1), 0.0, atol=1e-5)
+
+
+def test_loss_module_and_alpha_probe():
+    x, label, f_len, y_len = _loss_inputs(5)
+    mod = TransducerLoss()
+    dbg = []
+    out = mod(x, label, f_len, y_len, 6, debug_list=dbg)
+    assert out.shape == (3,)
+    assert dbg and dbg[0].shape == (3, x.shape[1], x.shape[2])
+
+
+def test_loss_packed_input_matches_dense():
+    x, label, f_len, y_len = _loss_inputs(6)
+    blank = 6
+    b, t, u_max, v = x.shape
+    # pack: per batch, rows (t, u) for t < f_len, u <= y_len, t-major
+    batch_offset = jnp.cumsum(f_len * (y_len + 1))
+    rows = []
+    for i in range(b):
+        for tt in range(int(f_len[i])):
+            for uu in range(int(y_len[i]) + 1):
+                rows.append(np.asarray(x[i, tt, uu]))
+    packed = jnp.asarray(np.stack(rows))
+
+    dense_loss_v = transducer_loss(x, label, f_len, y_len, blank)
+    mod = TransducerLoss(packed_input=True)
+    packed_loss = mod(packed, label, f_len, y_len, blank,
+                      batch_offset=batch_offset, max_f_len=t)
+    np.testing.assert_allclose(
+        np.asarray(packed_loss), np.asarray(dense_loss_v), rtol=1e-5)
+
+
+def test_loss_packed_requires_args():
+    x, label, f_len, y_len = _loss_inputs(7)
+    with pytest.raises(ValueError):
+        TransducerLoss(packed_input=True)(
+            x.reshape(-1, x.shape[-1]), label, f_len, y_len, 6)
+
+
+def test_joint_mask_probe_under_jit_via_return_mask():
+    """The value-returning probe works under jit (a mutated Python list
+    would hold a stale tracer — review r3 finding)."""
+    f, g, f_len, g_len = _joint_inputs(8)
+
+    @jax.jit
+    def run(f, g):
+        return transducer_joint(f, g, f_len, g_len, relu=True,
+                                return_mask=True)
+
+    out, mask = run(f, g)
+    out2, mask2 = run(f * 2, g * 2)
+    np.testing.assert_array_equal(np.asarray(mask), np.asarray(out) > 0)
+    assert not np.array_equal(np.asarray(out), np.asarray(out2))
+
+    # the module attribute keeps only the latest eager call's mask
+    j = TransducerJoint(relu=True, probe_mask=True)
+    j(f, g, f_len, g_len)
+    j(f, g, f_len, g_len)
+    assert len(j.mask_probe) == 1
+
+
+def test_loss_return_alphas_value_api():
+    x, label, f_len, y_len = _loss_inputs(9)
+
+    @jax.jit
+    def run(x):
+        return transducer_loss(x, label, f_len, y_len, 6, return_alphas=True)
+
+    losses, alphas = run(x)
+    assert alphas.shape == (3, x.shape[1], x.shape[2])
+    np.testing.assert_allclose(
+        np.asarray(losses),
+        np.asarray(transducer_loss(x, label, f_len, y_len, 6)), rtol=1e-6)
