@@ -4,13 +4,11 @@ from apex_tpu.contrib.multihead_attn.multihead_attn import (  # noqa: F401
     mask_softmax_dropout,
 )
 
-# reference functional-variant names (`fast_*` picked CUDA kernels; one
-# XLA/Pallas path serves all)
-self_attn_func = SelfMultiheadAttn
-fast_self_attn_func = SelfMultiheadAttn
-encdec_attn_func = EncdecMultiheadAttn
-fast_encdec_attn_func = EncdecMultiheadAttn
-mask_softmax_dropout_func = mask_softmax_dropout
+# NB: the reference's positional `*_attn_func` entry points
+# (self_attn_func(use_time_mask, is_training, heads, scale, ...)) are
+# torch.autograd.Function.apply signatures with no JAX analogue; they are
+# deliberately NOT aliased here — use the modules above or
+# apex_tpu.ops.flash_attention directly.
 
 __all__ = [
     "SelfMultiheadAttn",

@@ -27,7 +27,10 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_available,
+)
 from apex_tpu.ops.layer_norm import layer_norm
 
 Pytree = Any
@@ -79,7 +82,13 @@ def _attend(q, k, v, num_heads, scaling, key_padding_mask, attn_mask,
     kh = _split_heads(k, num_heads)
     vh = _split_heads(v, num_heads)
 
-    flash_ok = not mask_additive and attn_mask is None
+    s_q, s_k, d = qh.shape[2], kh.shape[2], qh.shape[3]
+    flash_ok = (
+        not mask_additive
+        and attn_mask is None
+        and flash_attention_available(
+            s_q, s_k, d, interpret=jax.default_backend() != "tpu")
+    )
     if flash_ok:
         kv_mask = None
         if key_padding_mask is not None:

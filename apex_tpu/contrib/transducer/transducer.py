@@ -96,7 +96,16 @@ def transducer_joint(
     packed = packed.at[dest.reshape(-1)].set(
         out.reshape(-1, h), mode="drop"
     )
-    return (packed[:packed_batch], mask) if return_mask else packed[:packed_batch]
+    if return_mask and mask is not None:
+        # pack the mask with the same layout so it corresponds to the
+        # packed output row-for-row (the reference kernel emits the mask
+        # for the packed tensor)
+        pm = jnp.zeros((packed_batch + 1, h), mask.dtype)
+        pm = pm.at[dest.reshape(-1)].set(mask.reshape(-1, h), mode="drop")
+        return packed[:packed_batch], pm[:packed_batch]
+    if return_mask:
+        return packed[:packed_batch], None
+    return packed[:packed_batch]
 
 
 class TransducerJoint:

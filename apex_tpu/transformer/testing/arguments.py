@@ -1,12 +1,14 @@
 """Megatron-style argument parser.
 
 Reference: ``apex/transformer/testing/arguments.py`` (977 LoC) — the full
-Megatron flag surface used by the test/benchmark harnesses. This port keeps
-the flags the TPU harnesses consume (model shape, TP/PP/SP sizes, precision,
-batching, recompute, loss scale, optimizer) plus validation mirroring
-``parse_args``'s consistency checks; CUDA-only knobs (``--ddp-impl``,
-NCCL/IB tuning, fused-kernel build flags) are accepted and ignored so
-reference command lines keep working.
+Megatron flag surface used by the test/benchmark harnesses. This port
+carries the reference's flag groups (network size, logging,
+regularization, training, initialization, learning rate, checkpointing,
+mixed precision, distributed, validation, data, autoresume, inference)
+with the semantics the TPU harnesses consume plus ``validate_args``
+consistency checks; CUDA-only knobs (``--DDP-impl``, NCCL/IB tuning,
+fused-kernel build toggles, memory-allocator switches) are accepted and
+ignored so reference command lines keep working unchanged.
 """
 from __future__ import annotations
 
@@ -28,11 +30,18 @@ def parse_args(
         description="apex_tpu Megatron-style arguments", allow_abbrev=False
     )
     _add_network_size_args(parser)
-    _add_training_args(parser)
+    _add_logging_args(parser)
     _add_regularization_args(parser)
+    _add_training_args(parser)
+    _add_initialization_args(parser)
+    _add_learning_rate_args(parser)
+    _add_checkpointing_args(parser)
     _add_mixed_precision_args(parser)
     _add_distributed_args(parser)
+    _add_validation_args(parser)
     _add_data_args(parser)
+    _add_autoresume_args(parser)
+    _add_inference_args(parser)
     if extra_args_provider is not None:
         parser = extra_args_provider(parser)
 
@@ -71,6 +80,9 @@ def validate_args(args):
         args.params_dtype = "float16"
     if args.bf16:
         args.params_dtype = "bfloat16"
+    if args.accumulate_allreduce_grads_in_fp32 is None:
+        # reference default: fp32 grad accumulation whenever 16-bit params
+        args.accumulate_allreduce_grads_in_fp32 = bool(args.fp16 or args.bf16)
 
     if args.ffn_hidden_size is None:
         args.ffn_hidden_size = 4 * args.hidden_size
@@ -84,6 +96,20 @@ def validate_args(args):
             raise ValueError(
                 "max_position_embeddings must be at least seq_length"
             )
+    # batch-size consistency (reference: micro * dp divides global)
+    if args.micro_batch_size is not None and args.global_batch_size is not None:
+        micro_times_dp = args.micro_batch_size * args.data_parallel_size
+        if args.global_batch_size % micro_times_dp != 0:
+            raise ValueError(
+                f"global batch size ({args.global_batch_size}) is not "
+                f"divisible by micro batch size ({args.micro_batch_size}) "
+                f"times data parallel size ({args.data_parallel_size})"
+            )
+    if args.rampup_batch_size is not None and len(args.rampup_batch_size) != 3:
+        raise ValueError(
+            "--rampup-batch-size takes exactly 3 values: "
+            "<start> <increment> <sample count>"
+        )
     if args.sequence_parallel and args.tensor_model_parallel_size == 1:
         # SP without TP is a no-op; the reference asserts similarly
         args.sequence_parallel = False
@@ -94,7 +120,26 @@ def validate_args(args):
         raise ValueError(
             "interleaved schedule requires pipeline size > 2"
         )
+    if args.recompute_method is not None and args.recompute_granularity != "full":
+        raise ValueError(
+            "--recompute-method is only meaningful with "
+            "--recompute-granularity full"
+        )
+    if args.lr_warmup_fraction is not None and args.lr_warmup_iters != 0:
+        raise ValueError(
+            "can only specify one of --lr-warmup-fraction and "
+            "--lr-warmup-iters"
+        )
+    if args.save_interval is not None and args.save is None:
+        raise ValueError("--save-interval requires --save")
     return args
+
+
+def _add_inference_args(parser):
+    group = parser.add_argument_group(title="inference")
+    group.add_argument("--inference-batch-times-seqlen-threshold", type=int,
+                       default=512)
+    return parser
 
 
 def _add_network_size_args(parser):
@@ -107,9 +152,54 @@ def _add_network_size_args(parser):
     group.add_argument("--max-position-embeddings", type=int, default=None)
     group.add_argument("--layernorm-epsilon", type=float, default=1e-5)
     group.add_argument("--vocab-size", type=int, default=None)
+    group.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
     group.add_argument(
         "--apply-query-key-layer-scaling", action="store_true", default=True
     )
+    group.add_argument("--apply-residual-connection-post-layernorm",
+                       action="store_true")
+    group.add_argument("--openai-gelu", action="store_true")
+    group.add_argument("--onnx-safe", type=bool, default=None)
+    group.add_argument("--bert-binary-head", action="store_true", default=True)
+    group.add_argument("--no-bert-binary-head", action="store_false",
+                       dest="bert_binary_head")
+    return parser
+
+
+def _add_logging_args(parser):
+    group = parser.add_argument_group(title="logging")
+    group.add_argument("--log-params-norm", action="store_true")
+    group.add_argument("--log-num-zeros-in-grad", action="store_true")
+    group.add_argument("--timing-log-level", type=int, default=0,
+                       choices=range(0, 3))
+    group.add_argument("--timing-log-option", type=str, default="minmax",
+                       choices=["max", "minmax", "all"])
+    group.add_argument("--tensorboard-dir", type=str, default=None)
+    group.add_argument("--tensorboard-log-interval", type=int, default=1)
+    group.add_argument("--tensorboard-queue-size", type=int, default=1000)
+    group.add_argument("--log-timers-to-tensorboard", action="store_true")
+    group.add_argument("--log-validation-ppl-to-tensorboard",
+                       action="store_true")
+    group.add_argument("--log-memory-to-tensorboard", action="store_true")
+    group.add_argument("--log-interval", type=int, default=100)
+    return parser
+
+
+def _add_regularization_args(parser):
+    group = parser.add_argument_group(title="regularization")
+    group.add_argument("--attention-dropout", type=float, default=0.1)
+    group.add_argument("--hidden-dropout", type=float, default=0.1)
+    group.add_argument("--weight-decay", type=float, default=0.01)
+    group.add_argument("--start-weight-decay", type=float, default=None)
+    group.add_argument("--end-weight-decay", type=float, default=None)
+    group.add_argument("--weight-decay-incr-style", type=str,
+                       default="constant",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--clip-grad", type=float, default=1.0)
+    group.add_argument("--adam-beta1", type=float, default=0.9)
+    group.add_argument("--adam-beta2", type=float, default=0.999)
+    group.add_argument("--adam-eps", type=float, default=1e-8)
+    group.add_argument("--sgd-momentum", type=float, default=0.9)
     return parser
 
 
@@ -119,17 +209,11 @@ def _add_training_args(parser):
     group.add_argument("--global-batch-size", type=int, default=None)
     group.add_argument("--rampup-batch-size", nargs="*", default=None)
     group.add_argument("--train-iters", type=int, default=None)
-    group.add_argument("--lr", type=float, default=None)
-    group.add_argument("--min-lr", type=float, default=0.0)
-    group.add_argument("--lr-decay-style", type=str, default="linear",
-                       choices=["constant", "linear", "cosine"])
-    group.add_argument("--lr-warmup-fraction", type=float, default=None)
+    group.add_argument("--train-samples", type=int, default=None)
+    group.add_argument("--exit-interval", type=int, default=None)
+    group.add_argument("--exit-duration-in-mins", type=int, default=None)
     group.add_argument("--optimizer", type=str, default="adam",
                        choices=["adam", "sgd", "lamb"])
-    group.add_argument("--adam-beta1", type=float, default=0.9)
-    group.add_argument("--adam-beta2", type=float, default=0.999)
-    group.add_argument("--adam-eps", type=float, default=1e-8)
-    group.add_argument("--clip-grad", type=float, default=1.0)
     group.add_argument(
         "--recompute-granularity", type=str, default=None,
         choices=["full", "selective"],
@@ -139,14 +223,62 @@ def _add_training_args(parser):
     group.add_argument("--recompute-num-layers", type=int, default=1)
     group.add_argument("--cpu-offload", action="store_true",
                        help="fork-added activation offload to host")
+    group.add_argument("--dataloader-type", type=str, default=None,
+                       choices=["single", "cyclic"])
+    group.add_argument("--no-async-tensor-model-parallel-allreduce",
+                       action="store_false",
+                       dest="async_tensor_model_parallel_allreduce")
+    group.add_argument("--no-persist-layer-norm", action="store_true")
+    group.add_argument("--sequence-parallel", action="store_true")
+    group.add_argument("--no-gradient-accumulation-fusion",
+                       action="store_false",
+                       dest="gradient_accumulation_fusion")
+    # CUDA fusion toggles accepted for parity (XLA owns fusion):
+    group.add_argument("--no-masked-softmax-fusion", action="store_false",
+                       dest="masked_softmax_fusion")
+    group.add_argument("--no-bias-gelu-fusion", action="store_false",
+                       dest="bias_gelu_fusion")
+    group.add_argument("--no-bias-dropout-fusion", action="store_false",
+                       dest="bias_dropout_fusion")
+    group.add_argument("--empty-unused-memory-level", type=int, default=0,
+                       choices=range(0, 3))
     return parser
 
 
-def _add_regularization_args(parser):
-    group = parser.add_argument_group(title="regularization")
-    group.add_argument("--attention-dropout", type=float, default=0.1)
-    group.add_argument("--hidden-dropout", type=float, default=0.1)
-    group.add_argument("--weight-decay", type=float, default=0.01)
+def _add_initialization_args(parser):
+    group = parser.add_argument_group(title="initialization")
+    group.add_argument("--seed", type=int, default=1234)
+    group.add_argument("--init-method-std", type=float, default=0.02)
+    group.add_argument("--init-method-xavier-uniform", action="store_true")
+    return parser
+
+
+def _add_learning_rate_args(parser):
+    group = parser.add_argument_group(title="learning rate")
+    group.add_argument("--lr", type=float, default=None)
+    group.add_argument("--lr-decay-style", type=str, default="linear",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--lr-decay-iters", type=int, default=None)
+    group.add_argument("--lr-decay-samples", type=int, default=None)
+    group.add_argument("--lr-warmup-fraction", type=float, default=None)
+    group.add_argument("--lr-warmup-iters", type=int, default=0)
+    group.add_argument("--lr-warmup-samples", type=int, default=0)
+    group.add_argument("--min-lr", type=float, default=0.0)
+    group.add_argument("--override-lr-scheduler", action="store_true")
+    group.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
+    return parser
+
+
+def _add_checkpointing_args(parser):
+    group = parser.add_argument_group(title="checkpointing")
+    group.add_argument("--save", type=str, default=None)
+    group.add_argument("--save-interval", type=int, default=None)
+    group.add_argument("--no-save-optim", action="store_true", default=None)
+    group.add_argument("--no-save-rng", action="store_true", default=None)
+    group.add_argument("--load", type=str, default=None)
+    group.add_argument("--no-load-optim", action="store_true", default=None)
+    group.add_argument("--no-load-rng", action="store_true", default=None)
+    group.add_argument("--finetune", action="store_true")
     return parser
 
 
@@ -159,6 +291,13 @@ def _add_mixed_precision_args(parser):
     group.add_argument("--min-loss-scale", type=float, default=1.0)
     group.add_argument("--loss-scale-window", type=float, default=1000)
     group.add_argument("--hysteresis", type=int, default=2)
+    group.add_argument("--fp32-residual-connection", action="store_true")
+    group.add_argument("--no-query-key-layer-scaling", action="store_false",
+                       dest="apply_query_key_layer_scaling")
+    group.add_argument("--attention-softmax-in-fp32", action="store_true")
+    group.add_argument("--accumulate-allreduce-grads-in-fp32",
+                       action="store_true", default=None)
+    group.add_argument("--fp16-lm-cross-entropy", action="store_true")
     return parser
 
 
@@ -172,22 +311,54 @@ def _add_distributed_args(parser):
     group.add_argument(
         "--pipeline-model-parallel-split-rank", type=int, default=None
     )
-    group.add_argument("--sequence-parallel", action="store_true")
     group.add_argument("--world-size", type=int, default=None)
     group.add_argument("--rank", type=int, default=0)
     group.add_argument("--local-rank", type=int, default=0)
+    group.add_argument("--lazy-mpu-init", type=bool, default=None)
     # CUDA-only knobs accepted for command-line parity (ignored):
-    group.add_argument("--DDP-impl", type=str, default="local")
-    group.add_argument("--use-cpu-initialization", action="store_true")
-    group.add_argument("--distributed-backend", type=str, default="xla")
+    group.add_argument("--DDP-impl", type=str, default="local",
+                       choices=["local", "torch"])
+    group.add_argument("--use-cpu-initialization", action="store_true",
+                       default=None)
+    group.add_argument("--distributed-backend", type=str, default="xla",
+                       choices=["nccl", "gloo", "ucc", "xla"])
+    group.add_argument("--use-ring-exchange-p2p", action="store_true")
+    group.add_argument("--standalone-embedding-stage", action="store_true")
+    return parser
+
+
+def _add_validation_args(parser):
+    group = parser.add_argument_group(title="validation")
+    group.add_argument("--eval-iters", type=int, default=100)
+    group.add_argument("--eval-interval", type=int, default=1000)
     return parser
 
 
 def _add_data_args(parser):
-    group = parser.add_argument_group(title="data")
+    group = parser.add_argument_group(title="data and dataloader")
+    group.add_argument("--data-path", nargs="*", default=None)
+    group.add_argument("--split", type=str, default="969, 30, 1")
+    group.add_argument("--vocab-file", type=str, default=None)
+    group.add_argument("--merge-file", type=str, default=None)
     group.add_argument("--seq-length", type=int, default=None)
     group.add_argument("--encoder-seq-length", type=int, default=None)
     group.add_argument("--decoder-seq-length", type=int, default=None)
+    group.add_argument("--retriever-seq-length", type=int, default=256)
+    group.add_argument("--mask-prob", type=float, default=0.15)
+    group.add_argument("--short-seq-prob", type=float, default=0.1)
+    group.add_argument("--mmap-warmup", action="store_true")
     group.add_argument("--num-workers", type=int, default=2)
-    group.add_argument("--seed", type=int, default=1234)
+    group.add_argument("--tokenizer-type", type=str, default=None,
+                       choices=["BertWordPieceLowerCase",
+                                "BertWordPieceCase", "GPT2BPETokenizer"])
+    group.add_argument("--reset-position-ids", action="store_true")
+    group.add_argument("--reset-attention-mask", action="store_true")
+    group.add_argument("--eod-mask-loss", action="store_true")
+    return parser
+
+
+def _add_autoresume_args(parser):
+    group = parser.add_argument_group(title="autoresume")
+    group.add_argument("--adlr-autoresume", action="store_true")
+    group.add_argument("--adlr-autoresume-interval", type=int, default=1000)
     return parser

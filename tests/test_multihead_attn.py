@@ -33,15 +33,28 @@ def _ref_self_attn(params, x, module, key_padding_mask=None, attn_mask=None):
         var = ((x - mu) ** 2).mean(-1, keepdims=True)
         xn = (x - mu) * jax.lax.rsqrt(var + 1e-5)
         x = xn * params["lyr_nrm_gamma_weights"] + params["lyr_nrm_beta_weights"]
-    w, b = module._in_proj(params)
-    qkv = jnp.einsum("sbh,oh->sbo", x, w)
-    if b is not None:
-        qkv = qkv + b
     s_len = x.shape[0]
-    qkv = qkv.reshape(s_len, B, n, 3, d)
-    q = qkv[..., 0, :].transpose(1, 2, 0, 3)  # [b, n, s, d]
-    k = qkv[..., 1, :].transpose(1, 2, 0, 3)
-    v = qkv[..., 2, :].transpose(1, 2, 0, 3)
+    if module.separate_qkv_params:
+        # project with the raw per-matrix weights (NOT module._in_proj —
+        # that is the code under test); split heads directly
+        def proj(wk, bk):
+            y = jnp.einsum("sbh,oh->sbo", x, params[wk])
+            if module.bias:
+                y = y + params[bk]
+            return y.reshape(s_len, B, n, d).transpose(1, 2, 0, 3)
+
+        q = proj("q_weight", "q_bias")
+        k = proj("k_weight", "k_bias")
+        v = proj("v_weight", "v_bias")
+    else:
+        w = params["in_proj_weight"]
+        qkv = jnp.einsum("sbh,oh->sbo", x, w)
+        if module.bias:
+            qkv = qkv + params["in_proj_bias"]
+        qkv = qkv.reshape(s_len, B, n, 3, d)
+        q = qkv[..., 0, :].transpose(1, 2, 0, 3)  # [b, n, s, d]
+        k = qkv[..., 1, :].transpose(1, 2, 0, 3)
+        v = qkv[..., 2, :].transpose(1, 2, 0, 3)
     scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) * module.scaling
     if key_padding_mask is not None:
         scores = jnp.where(

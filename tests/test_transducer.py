@@ -205,3 +205,18 @@ def test_loss_return_alphas_value_api():
     np.testing.assert_allclose(
         np.asarray(losses),
         np.asarray(transducer_loss(x, label, f_len, y_len, 6)), rtol=1e-6)
+
+
+def test_joint_packed_mask_matches_packed_output():
+    """With pack_output + return_mask the mask is packed row-for-row with
+    the output (review r3: a dense mask against a packed output is
+    unusable)."""
+    f, g, f_len, g_len = _joint_inputs(10)
+    batch_offset = jnp.cumsum(f_len * g_len)
+    packed_batch = int(batch_offset[-1])
+    out, mask = transducer_joint(
+        f, g, f_len, g_len, pack_output=True, relu=True,
+        batch_offset=batch_offset, packed_batch=packed_batch,
+        return_mask=True)
+    assert mask.shape == out.shape
+    np.testing.assert_array_equal(np.asarray(mask), np.asarray(out) > 0)
