@@ -74,14 +74,18 @@ def run_pipeline_interleaved(
     *,
     forward_only: bool = False,
     checkpoint_stages: bool = True,
+    tick_checkpoint=None,
 ):
     """Single-axis wrapper; ``stage_params_chunks`` leaves are
-    ``[pp, vpp, ...]``, pipeline-sharded on the first axis."""
+    ``[pp, vpp, ...]``, pipeline-sharded on the first axis.
+    ``tick_checkpoint=K`` enables sqrt-style tick remat (see
+    ``pipeline_rounds``) — most valuable here, where the tick count is
+    ``n_micro*vpp``."""
     vpp = jax.tree_util.tree_leaves(stage_params_chunks)[0].shape[1]
     return run_pipeline(
         mesh, stage_fn, loss_fn, stage_params_chunks, inputs, extras,
         forward_only=forward_only, checkpoint_stages=checkpoint_stages,
-        num_chunks=vpp,
+        num_chunks=vpp, tick_checkpoint=tick_checkpoint,
     )
 
 

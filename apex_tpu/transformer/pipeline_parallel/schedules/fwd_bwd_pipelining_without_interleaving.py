@@ -17,12 +17,13 @@ structurally the same drain the reference's cooldown loop implements. With
 ``checkpoint_stages=True`` each stage call is rematerialised in backward.
 
 Honest memory note: autodiff through the scan saves the per-tick stage
-*boundary* activations, so live memory is O(n_micro) boundary tensors plus
-(with remat) one stage's internals — not the O(pp) in-flight bound true
-1F1B achieves by interleaving each microbatch's backward into the steady
-state. Fine at the microbatch counts the tests and benches use; a
-re-circulating custom-vjp schedule would be needed to reproduce the exact
-1F1B footprint at very large ``n_micro``.
+*boundary* activations — O(n_micro·vpp) of them (the final outputs are
+accumulated into an O(n_micro) carry buffer rather than stacked per tick).
+``tick_checkpoint=K`` cuts the saved boundaries to O(total/K + K)
+(sqrt-style nested remat) at one extra forward per tick. That is still not
+the O(pp) in-flight bound true 1F1B achieves by interleaving each
+microbatch's backward into the steady state — a re-circulating custom-vjp
+schedule would be needed for the exact 1F1B footprint.
 
 This function is the *local* (inside-``shard_map``) form so it composes
 with TP/SP/DP axes; ``run_pipeline`` wraps it in a shard_map for the
@@ -51,6 +52,7 @@ def pipeline_rounds(
     axis_name: str,
     checkpoint_stages: bool,
     num_chunks: Optional[int] = None,
+    tick_checkpoint: Optional[int] = None,
 ) -> jax.Array:
     """Stream all microbatches through ``vpp = len(chunks)`` traversals of
     the stage ring in ONE continuous scan of ``n·vpp + pp − 1`` ticks —
@@ -74,6 +76,11 @@ def pipeline_rounds(
     Requires ``n % pp == 0`` when ``vpp > 1`` (the reference asserts the
     same). Returns the final-chunk outputs ``[n, ...]`` microbatch-ordered,
     valid on the last stage.
+
+    ``tick_checkpoint=K`` nests the scan into remat'd K-tick chunks
+    (sqrt-style checkpointing): backward saves only chunk-boundary
+    activations — peak residual memory O(total/K + K) boundary tensors
+    instead of O(total) — at the cost of one extra forward of each tick.
     """
     pp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -103,7 +110,8 @@ def pipeline_rounds(
     perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
     total = n * vpp + pp - 1  # ticks
 
-    def body(state, t):
+    def body(carry, t):
+        state, outs = carry
         # the item this rank processes entered stage 0 at tick u
         u = jnp.clip(t - rank, 0, n * vpp - 1)
         c = (u // pp) % vpp  # chunk this rank applies at tick t
@@ -122,7 +130,22 @@ def pipeline_rounds(
             )
         y = fwd(params_c, x)
         new_state = jax.lax.ppermute(y, axis_name, perm_fwd)
-        return new_state, y
+        # accumulate final-chunk outputs into a [n, ...] carry buffer
+        # instead of stacking every tick's y ([total, ...]) and gathering —
+        # forward live memory drops from O(total) to O(n) output rows.
+        # Microbatch m = g·pp + i emits at tick g·vpp·pp + (vpp−1)·pp + i
+        # + (pp−1) on the LAST stage; other ranks' writes are garbage rows
+        # that the masked loss never reads (same as the old gather).
+        uo = t - (pp - 1)
+        is_out = (uo >= 0) & (uo < n * vpp) & (
+            ((jnp.clip(uo, 0, n * vpp - 1) // pp) % vpp) == vpp - 1
+        )
+        uo = jnp.clip(uo, 0, n * vpp - 1)
+        m_out = jnp.clip((uo // (vpp * pp)) * pp + uo % pp, 0, n - 1)
+        cur = jax.lax.dynamic_index_in_dim(outs, m_out, 0, keepdims=False)
+        row = jnp.where(is_out, y, cur)
+        outs = jax.lax.dynamic_update_index_in_dim(outs, row, m_out, 0)
+        return (new_state, outs), None
 
     # the carry is pipeline-varying (it came through a ppermute), and under a
     # composed mesh the stage output inherits whatever axes the params or
@@ -131,16 +154,33 @@ def pipeline_rounds(
     init = pvary_union_like(
         jnp.zeros_like(inputs[0]), (inputs, stacked), (axis_name,)
     )
-    _, ys = jax.lax.scan(body, init, jnp.arange(total))
-    # on the last stage, microbatch m = g·pp + i finishes its final chunk at
-    # tick g·vpp·pp + (vpp−1)·pp + i + (pp−1); gather those rows (static idx)
-    t_out = np.array(
-        [
-            (m // pp) * vpp * pp + (vpp - 1) * pp + (m % pp) + pp - 1
-            for m in range(n)
-        ]
+    outs0 = pvary_union_like(
+        jnp.zeros_like(inputs), (inputs, stacked), (axis_name,)
     )
-    return ys[t_out]  # [n, ...] microbatch-ordered, valid on last stage
+    if tick_checkpoint is None:
+        (_, outs), _ = jax.lax.scan(body, (init, outs0), jnp.arange(total))
+    else:
+        # sqrt-style nested remat over tick chunks: only chunk-boundary
+        # carries are saved by the outer scan; inner ticks rematerialise in
+        # backward — peak residual memory O(total/K + K) boundary
+        # activations instead of O(total). Pad with harmless ticks (their
+        # clipped indices recompute existing microbatches; is_out masks
+        # their output writes).
+        k = int(tick_checkpoint)
+        if k <= 0:
+            raise ValueError(f"tick_checkpoint must be positive, got {k}")
+        n_outer = -(-total // k)
+
+        @jax.checkpoint
+        def outer_body(carry, t0):
+            return jax.lax.scan(
+                body, carry, t0 + jnp.arange(k)
+            )
+
+        (_, outs), _ = jax.lax.scan(
+            outer_body, (init, outs0), jnp.arange(n_outer) * k
+        )
+    return outs  # [n, ...] microbatch-ordered, valid on last stage
 
 
 def pipeline_forward_backward(
@@ -155,6 +195,7 @@ def pipeline_forward_backward(
     checkpoint_stages: bool = True,
     grad_scaler: Optional[Callable] = None,
     num_chunks: int = 1,
+    tick_checkpoint: Optional[int] = None,
     **parity_kwargs,
 ):
     """Local (inside-shard_map) 1F1B-equivalent forward+backward.
@@ -189,7 +230,7 @@ def pipeline_forward_backward(
     def local_loss(params, inputs):
         outs = pipeline_rounds(
             stage_fn, params, inputs, a, checkpoint_stages,
-            num_chunks=num_chunks,
+            num_chunks=num_chunks, tick_checkpoint=tick_checkpoint,
         )
 
         # emit per-microbatch losses and sum after — no carry, so neither
@@ -245,6 +286,7 @@ def run_pipeline(
     forward_only: bool = False,
     checkpoint_stages: bool = True,
     num_chunks: int = 1,
+    tick_checkpoint: Optional[int] = None,
 ):
     """Convenience single-axis wrapper: shard_map the local schedule over the
     ``pipeline`` mesh axis. ``stage_params`` leaves carry a leading ``[pp]``
@@ -268,6 +310,7 @@ def run_pipeline(
                 stage_fn, loss_fn, params, inputs, extras,
                 forward_only=True, axis_name=ax,
                 checkpoint_stages=checkpoint_stages, num_chunks=num_chunks,
+                tick_checkpoint=tick_checkpoint,
             )
             return loss
 
@@ -282,6 +325,7 @@ def run_pipeline(
             stage_fn, loss_fn, params, inputs, extras,
             forward_only=False, axis_name=ax,
             checkpoint_stages=checkpoint_stages, num_chunks=num_chunks,
+            tick_checkpoint=tick_checkpoint,
         )
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
         return loss, grads, dinp
