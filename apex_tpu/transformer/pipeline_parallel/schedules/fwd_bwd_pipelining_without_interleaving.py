@@ -19,8 +19,10 @@ structurally the same drain the reference's cooldown loop implements. With
 Honest memory note: autodiff through the scan saves the per-tick stage
 *boundary* activations — O(n_micro·vpp) of them (the final outputs are
 accumulated into an O(n_micro) carry buffer rather than stacked per tick).
-``tick_checkpoint=K`` cuts the saved boundaries to O(total/K + K)
-(sqrt-style nested remat) at one extra forward per tick. That is still not
+``tick_checkpoint=K`` cuts the saved boundaries to O(total/K)
+(sqrt-style nested remat; chunk outputs leave the remat region as
+compressed emission slots) at the cost of replaying tick forwards in
+backward. That is still not
 the O(pp) in-flight bound true 1F1B achieves by interleaving each
 microbatch's backward into the steady state — a re-circulating custom-vjp
 schedule would be needed for the exact 1F1B footprint.
@@ -36,7 +38,6 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ... import parallel_state
 from ..utils import pvary_union_like, vma_tracking_active
@@ -78,9 +79,12 @@ def pipeline_rounds(
     valid on the last stage.
 
     ``tick_checkpoint=K`` nests the scan into remat'd K-tick chunks
-    (sqrt-style checkpointing): backward saves only chunk-boundary
-    activations — peak residual memory O(total/K + K) boundary tensors
-    instead of O(total) — at the cost of one extra forward of each tick.
+    (sqrt-style checkpointing): backward saves only the chunk-boundary
+    ring states — O(total/K) boundary activations instead of O(total) —
+    at the cost of replaying each tick's forward in backward (twice with
+    ``checkpoint_stages``). Chunk outputs leave the remat region as
+    compressed emission slots, so the [n, ...] output buffer is never
+    part of a saved carry.
     """
     pp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -110,8 +114,9 @@ def pipeline_rounds(
     perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
     total = n * vpp + pp - 1  # ticks
 
-    def body(carry, t):
-        state, outs = carry
+    def tick(state, t):
+        """One pipeline tick: (ring state, t) -> (new state, this tick's
+        stage output y + its output bookkeeping)."""
         # the item this rank processes entered stage 0 at tick u
         u = jnp.clip(t - rank, 0, n * vpp - 1)
         c = (u // pp) % vpp  # chunk this rank applies at tick t
@@ -130,18 +135,23 @@ def pipeline_rounds(
             )
         y = fwd(params_c, x)
         new_state = jax.lax.ppermute(y, axis_name, perm_fwd)
-        # accumulate final-chunk outputs into a [n, ...] carry buffer
-        # instead of stacking every tick's y ([total, ...]) and gathering —
-        # forward live memory drops from O(total) to O(n) output rows.
-        # Microbatch m = g·pp + i emits at tick g·vpp·pp + (vpp−1)·pp + i
-        # + (pp−1) on the LAST stage; other ranks' writes are garbage rows
-        # that the masked loss never reads (same as the old gather).
+        # microbatch m = g·pp + i finishes its final chunk at tick
+        # g·vpp·pp + (vpp−1)·pp + i + (pp−1) (on the LAST stage; other
+        # ranks' emissions are garbage rows the masked loss never reads)
         uo = t - (pp - 1)
         is_out = (uo >= 0) & (uo < n * vpp) & (
             ((jnp.clip(uo, 0, n * vpp - 1) // pp) % vpp) == vpp - 1
         )
         uo = jnp.clip(uo, 0, n * vpp - 1)
         m_out = jnp.clip((uo // (vpp * pp)) * pp + uo % pp, 0, n - 1)
+        return new_state, (y, m_out, is_out)
+
+    def body(carry, t):
+        """Plain-path body: accumulate final outputs into an [n, ...]
+        carry buffer instead of stacking every tick's y ([total, ...]) —
+        forward live memory O(n) output rows."""
+        state, outs = carry
+        new_state, (y, m_out, is_out) = tick(state, t)
         cur = jax.lax.dynamic_index_in_dim(outs, m_out, 0, keepdims=False)
         row = jnp.where(is_out, y, cur)
         outs = jax.lax.dynamic_update_index_in_dim(outs, row, m_out, 0)
@@ -159,28 +169,65 @@ def pipeline_rounds(
     )
     if tick_checkpoint is None:
         (_, outs), _ = jax.lax.scan(body, (init, outs0), jnp.arange(total))
-    else:
-        # sqrt-style nested remat over tick chunks: only chunk-boundary
-        # carries are saved by the outer scan; inner ticks rematerialise in
-        # backward — peak residual memory O(total/K + K) boundary
-        # activations instead of O(total). Pad with harmless ticks (their
-        # clipped indices recompute existing microbatches; is_out masks
-        # their output writes).
-        k = int(tick_checkpoint)
-        if k <= 0:
-            raise ValueError(f"tick_checkpoint must be positive, got {k}")
-        n_outer = -(-total // k)
+        return outs  # [n, ...] microbatch-ordered, valid on last stage
 
-        @jax.checkpoint
-        def outer_body(carry, t0):
-            return jax.lax.scan(
-                body, carry, t0 + jnp.arange(k)
-            )
+    # sqrt-style nested remat over K-tick chunks. The remat'd region's
+    # carry is the ring state ONLY (one boundary activation per chunk) —
+    # NOT the [n, ...] outs buffer, which an outer-scan carry would re-save
+    # at every boundary (O(n_outer * n) residuals, defeating the point).
+    # Instead each chunk emits its (at most n_emit) final-output rows as
+    # compressed remat-region OUTPUTS, scattered into [n, ...] once after
+    # the scan. Residuals: O(total/K) boundary states; recompute: each
+    # tick's forward replays in backward (twice with checkpoint_stages).
+    # Padding ticks (K not dividing total) recompute clipped indices
+    # harmlessly with is_out masked off.
+    k = int(tick_checkpoint)
+    if k <= 0:
+        raise ValueError(f"tick_checkpoint must be positive, got {k}")
+    n_outer = -(-total // k)
+    # emissions within K ticks: one pp-tick block per vpp*pp period
+    n_emit = min(k, (k // (vpp * pp) + 2) * pp)
 
-        (_, outs), _ = jax.lax.scan(
-            outer_body, (init, outs0), jnp.arange(n_outer) * k
+    @jax.checkpoint
+    def outer_body(state, t0):
+        emit0 = (
+            pvary_union_like(
+                jnp.zeros((n_emit,) + inputs.shape[1:], inputs.dtype),
+                (inputs, stacked), (axis_name,)
+            ),
+            jnp.zeros((n_emit,), jnp.int32),
+            jnp.zeros((n_emit,), jnp.bool_),
+            jnp.int32(0),  # next free slot
         )
-    return outs  # [n, ...] microbatch-ordered, valid on last stage
+
+        def inner(carry, t):
+            state, (rows, idxs, valids, slot) = carry
+            new_state, (y, m_out, is_out) = tick(state, t)
+            s = jnp.clip(slot, 0, n_emit - 1)
+            cur = jax.lax.dynamic_index_in_dim(rows, s, 0, keepdims=False)
+            rows = jax.lax.dynamic_update_index_in_dim(
+                rows, jnp.where(is_out, y, cur), s, 0)
+            idxs = jnp.where(
+                is_out, idxs.at[s].set(m_out.astype(jnp.int32)), idxs)
+            valids = jnp.where(is_out, valids.at[s].set(True), valids)
+            slot = slot + is_out.astype(jnp.int32)
+            return (new_state, (rows, idxs, valids, slot)), None
+
+        (state, emits), _ = jax.lax.scan(
+            inner, (state, emit0), t0 + jnp.arange(k))
+        return state, emits[:3]
+
+    _, (rows, idxs, valids) = jax.lax.scan(
+        outer_body, init, jnp.arange(n_outer) * k)
+    # scatter all chunk emissions into the [n, ...] output buffer; invalid
+    # slots go to row n (dropped)
+    flat_rows = rows.reshape((n_outer * n_emit,) + inputs.shape[1:])
+    dest = jnp.where(
+        valids.reshape(-1), idxs.reshape(-1), n).astype(jnp.int32)
+    outs = jnp.zeros_like(
+        jnp.concatenate([outs0, outs0[:1]], axis=0))
+    outs = outs.at[dest].set(flat_rows, mode="drop")
+    return outs[:n]  # [n, ...] microbatch-ordered, valid on last stage
 
 
 def pipeline_forward_backward(

@@ -172,6 +172,61 @@ def bench_bert_lamb(iters, batch, seq):
     return dt / iters, final_loss, flops
 
 
+def bench_resnet_o2(iters, batch):
+    """BASELINE config #1: ResNet-50 + amp O2 + FusedSGD (examples/imagenet),
+    device-resident synthetic batch (steady-state input pipeline)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples", "imagenet"))
+    import numpy as _np
+    import resnet as resnet_lib
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedSGD
+
+    model = resnet_lib.build_model("resnet50", num_classes=1000)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 224, 224, 3), jnp.float32),
+        train=False)
+    params, bstats = variables["params"], variables["batch_stats"]
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    params, opt, amp_state = amp.initialize(params, opt, opt_level="O2")
+    scaler = amp_state.scaler(0)
+    sstate = amp_state.scaler_state(0)
+    opt_state = opt.init(params)
+
+    rng = _np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (batch, 224, 224, 3), dtype=_np.uint8))
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)).astype(_np.int32))
+
+    grad_fn = amp.scaled_value_and_grad(
+        lambda p, b: _resnet_loss(model, p, b, x, y), scaler, has_aux=True)
+
+    def train_step(params, bstats, opt_state, sstate, loss_prev):
+        (loss, new_bstats), grads, sstate = grad_fn(sstate, params, bstats)
+        params, opt_state = opt.step(
+            grads, opt_state, params, found_inf=sstate.found_inf)
+        sstate = scaler.update_scale(sstate)
+        return params, new_bstats, opt_state, sstate, loss
+
+    train_step = jax.jit(train_step)
+    dt, final_loss = _timed_steps(
+        train_step, (params, bstats, opt_state, sstate, jnp.float32(0)),
+        iters)
+    return dt / iters, final_loss
+
+
+def _resnet_loss(model, params, bstats, x, y):
+    xs = (x.astype(jnp.float32) - 127.5) / 58.0
+    logits, upd = model.apply(
+        {"params": params, "batch_stats": bstats},
+        xs.astype(jnp.bfloat16), train=True, mutable=["batch_stats"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    return loss, upd["batch_stats"]
+
+
 def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
@@ -225,6 +280,21 @@ def main() -> None:
             "optimizer": "FusedLAMB",
         }
 
+    resnet = None
+    if not fast:
+        r_batch = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
+        r_step, r_loss = bench_resnet_o2(iters, r_batch)
+        if not math.isfinite(r_loss):
+            raise SystemExit(f"ResNet final loss is not finite: {r_loss}")
+        resnet = {
+            "step_ms": round(r_step * 1000.0, 2),
+            "images_per_sec": round(r_batch / r_step, 1),
+            "final_loss": round(r_loss, 4),
+            "batch": r_batch,
+            "optimizer": "FusedSGD",
+            "opt_level": "O2",
+        }
+
     vs_baseline = None
     try:
         with open(os.path.join(
@@ -256,6 +326,7 @@ def main() -> None:
         "vs_xla_attention": (round(vs_xla_attention, 4)
                              if vs_xla_attention else None),
         "bert_large_lamb": bert,
+        "resnet50_o2": resnet,
         "batch": batch,
         "seq": seq,
         "recompute": remat or None,
