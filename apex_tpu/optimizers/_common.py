@@ -38,7 +38,11 @@ def tree_zeros_like(tree: Pytree, dtype=jnp.float32) -> Pytree:
 
 
 def tree_f32(tree: Pytree) -> Pytree:
-    return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), tree)
+    # force a copy even for leaves already fp32 (astype would alias the
+    # input buffer, and master copies aliasing params break buffer donation
+    # of params+opt_state into a jitted step)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.array(x, jnp.float32, copy=True), tree)
 
 
 def multi_tree_update(fn: Callable, n_out: int, grads: Pytree, *trees: Pytree):

@@ -33,7 +33,6 @@ single-axis case.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax

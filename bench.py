@@ -210,7 +210,7 @@ def bench_resnet_o2(iters, batch):
         sstate = scaler.update_scale(sstate)
         return params, new_bstats, opt_state, sstate, loss
 
-    train_step = jax.jit(train_step)
+    train_step = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
     dt, final_loss = _timed_steps(
         train_step, (params, bstats, opt_state, sstate, jnp.float32(0)),
         iters)

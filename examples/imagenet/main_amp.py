@@ -221,10 +221,7 @@ def make_train_step(model, optimizer, scaler, mesh, half_dtype, cast_input):
         out_specs=(rep, rep, rep, rep, rep, rep, rep),
         check_vma=True,
     )
-    # no donation: under O2 the fp32 (batchnorm) param leaves alias the
-    # optimizer's fp32 master copies (astype is a no-op), and XLA rejects
-    # donating the same buffer twice
-    return jax.jit(inner)
+    return jax.jit(inner, donate_argnums=(0, 1, 2, 3))
 
 
 def make_eval_step(model, mesh, half_dtype, cast_input):

@@ -83,15 +83,18 @@ def test_train_step_overflow_skips_params_and_bn_stats():
     x = jnp.full((16, 16, 16, 3), 1e30, jnp.float32)  # forces nonfinite grads
     y = jnp.zeros((16,), jnp.int32)
     scale_before = float(sstate.loss_scale)
+    # the train step donates its state buffers — snapshot to host first
+    params_before = jax.tree_util.tree_map(np.asarray, params)
+    bstats_before = jax.tree_util.tree_map(np.asarray, batch_stats)
     new_params, new_bstats, _, new_sstate, loss, _, _ = step(
         params, batch_stats, opt_state, sstate, x, y, jnp.float32(0.1))
 
-    for a, b in zip(jax.tree_util.tree_leaves(params),
+    for a, b in zip(jax.tree_util.tree_leaves(params_before),
                     jax.tree_util.tree_leaves(new_params)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-    for a, b in zip(jax.tree_util.tree_leaves(batch_stats),
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(bstats_before),
                     jax.tree_util.tree_leaves(new_bstats)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(a, np.asarray(b))
     assert float(new_sstate.loss_scale) == scale_before / 2
 
 

@@ -451,3 +451,33 @@ def test_tick_checkpoint_equivalent(pp_mesh):
             np.asarray(grads["w"]), np.asarray(base_grads["w"]), atol=1e-6)
         np.testing.assert_allclose(
             np.asarray(dinp), np.asarray(base_dinp), atol=1e-6)
+
+
+def test_tick_checkpoint_interleaved_equivalent(pp_mesh):
+    """tick_checkpoint composed with virtual chunks (vpp=2): the
+    emission-slot capacity depends on vpp — pin exact equality vs the
+    un-checkpointed interleaved run."""
+    VPP = 2
+    NM = 2 * PP
+    flat = _make_params(jax.random.PRNGKey(40), PP * VPP)
+    params = {
+        k: jnp.stack(
+            [jnp.stack([flat[k][v * PP + s] for v in range(VPP)])
+             for s in range(PP)])
+        for k in flat
+    }
+    inputs = jax.random.normal(jax.random.PRNGKey(41), (NM, MBS, H))
+    targets = jax.random.normal(jax.random.PRNGKey(42), (NM, MBS, H))
+
+    base_loss, base_grads, _ = run_pipeline_interleaved(
+        pp_mesh, _stage_fn, _loss_fn, params, inputs, targets)
+    for k in (4, 6):  # total = 19 ticks: both pad
+        loss, grads, _ = jax.jit(
+            lambda p, i, t, k=k: run_pipeline_interleaved(
+                pp_mesh, _stage_fn, _loss_fn, p, i, t, tick_checkpoint=k)
+        )(params, inputs, targets)
+        np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-6)
+        for key in params:
+            np.testing.assert_allclose(
+                np.asarray(grads[key]), np.asarray(base_grads[key]),
+                atol=1e-6, err_msg=f"{key} k={k}")
