@@ -1,3 +1,11 @@
+"""OpenFold training pack (reference ``apex/contrib/openfold_triton``).
+
+``FusedAdamSWA`` is the pack's unique capability. The reference's other
+Triton kernels collapse into existing apex_tpu components: ``_mha_kernel``
+-> ``apex_tpu.ops.flash_attention`` (same online-softmax attention);
+``_layer_norm_{forward,backward}_kernels`` -> ``apex_tpu.ops.layer_norm``;
+the auto-tune cache sync is CUDA-launch machinery XLA owns.
+"""
 from apex_tpu.contrib.openfold.fused_adam_swa import (  # noqa: F401
     AdamMathType,
     FusedAdamSWA,
