@@ -16,6 +16,8 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .common import warn_ignored_parity_kwargs
+
 Pytree = Any
 
 
@@ -44,11 +46,11 @@ def forward_backward_no_pipelining(
     convention, ``forward_step`` dividing by num_microbatches), or
     ``(mean_loss, None)`` with ``forward_only=True``.
 
-    Accepted-for-parity kwargs (``tensor_shape``, ``dtype``,
-    ``custom_sync_context_handler``, ...) are ignored: XLA owns those
-    mechanics.
+    Accepted-for-parity kwargs: mechanical ones (``tensor_shape``,
+    ``dtype``, ...) are ignored silently — XLA owns those mechanics;
+    semantic ones (``custom_sync_context_handler``, ...) warn once.
     """
-    del parity_kwargs
+    warn_ignored_parity_kwargs("forward_backward_no_pipelining", parity_kwargs)
     n = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
 
     def one_loss(p, mb, ex):

