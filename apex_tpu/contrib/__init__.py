@@ -17,13 +17,15 @@ Python wrappers). Subpackages, mirroring the reference's layout:
 - ``contrib.gpu_direct_storage`` — ``GDSFile`` raw tensor<->file IO
   (whole-pytree sharded checkpointing lives in ``apex_tpu.checkpoint``)
 - ``contrib.transducer`` — RNN-T joint (+packing/epilogues) and loss
+- ``contrib.fmha`` — packed-qkv varlen fused MHA (``FMHA``/``fmha_varlen``
+  in the reference's ``cu_seqlens`` calling convention)
 - ``contrib.multihead_attn`` — fused self/encdec MHA modules (bias,
   norm-add residual, additive/padding masks, in-kernel dropout)
 - ``contrib.conv_bias_relu`` — fused Conv+Bias(+ReLU/+Mask) ops
 - ``contrib.groupbn`` / ``contrib.cudnn_gbn`` — NHWC group-synced
   BatchNorm (+add/relu epilogues)
-- ``contrib.openfold`` — ``FusedAdamSWA`` (Adam + stochastic weight
-  averaging in one fused step; the ``openfold_triton`` pack's optimizer)
+- ``contrib.openfold`` — the ``openfold_triton`` pack: ``FusedAdamSWA``,
+  pair-biased fused attention (``AttnTri``), small-shape LayerNorm
 """
 import importlib
 
@@ -40,6 +42,7 @@ _LAZY = (
     "bottleneck",
     "gpu_direct_storage",
     "transducer",
+    "fmha",
     "multihead_attn",
     "conv_bias_relu",
     "groupbn",

@@ -1,0 +1,14 @@
+"""apex_tpu.contrib.fmha — fused multi-head attention, varlen-first.
+
+Reference: ``apex/contrib/fmha/fmha.py`` — ``FMHAFun`` (``:33-92``) and the
+``FMHA`` module (``:60``) over the ``fmhalib`` CUDA kernels
+(``contrib/csrc/fmha/``, ~6k LoC, fp16, seq<=512, packed ``[total, 3, h, d]``
+qkv + ``cu_seqlens``).
+
+TPU version: :func:`apex_tpu.ops.flash_attention.flash_attention_varlen`
+(any length/dtype, in-kernel dropout) behind the reference's packed-qkv
+calling convention.
+"""
+from apex_tpu.contrib.fmha.fmha import FMHA, fmha_varlen  # noqa: F401
+
+__all__ = ["FMHA", "fmha_varlen"]

@@ -1,0 +1,87 @@
+"""Packed-qkv varlen fused MHA — the reference fmha calling convention.
+
+Reference: ``apex/contrib/fmha/fmha.py`` — ``FMHAFun.forward(qkv,
+cu_seqlens, p_dropout, max_s, is_training, zero_tensors)`` (``:33-47``)
+over CUDA kernels limited to fp16 and seq<=512 with per-seqlen template
+instantiations and a small-batch ``fwd_nl`` variant; the ``FMHA`` module
+(``:60-80``) reshapes ``[total, hidden]`` -> ``[total, 3, h, d]`` and back.
+
+TPU version: one tiled Pallas kernel for any length/dtype
+(:func:`apex_tpu.ops.flash_attention.flash_attention_varlen`, segment-id
+masking from ``cu_seqlens``, in-kernel hash dropout). ``max_s`` and
+``zero_tensors`` are CUDA buffer-management knobs with no XLA analogue
+(static shapes; XLA owns buffers) — accepted and ignored for call-site
+parity. The batch-size-dependent kernel choice (``fmha.py:38-42``)
+disappears: the grid covers any batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.ops.flash_attention import flash_attention_varlen
+
+
+def fmha_varlen(
+    qkv: jax.Array,  # [total, 3, h, d] packed
+    cu_seqlens: jax.Array,  # [b+1] int32, cu[0] == 0
+    p_dropout: float = 0.0,
+    max_s: Optional[int] = None,
+    is_training: bool = True,
+    zero_tensors: bool = False,
+    *,
+    dropout_seed=None,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """``FMHAFun`` analogue: returns the attention context
+    ``[total, h, d]``. Dropout needs ``dropout_seed`` when
+    ``is_training`` and ``p_dropout > 0`` (the Philox-offset analogue)."""
+    del max_s, zero_tensors  # static shapes; XLA owns buffers
+    if qkv.ndim != 4 or qkv.shape[1] != 3:
+        raise ValueError(f"qkv must be [total, 3, h, d], got {qkv.shape}")
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    p = p_dropout if is_training else 0.0
+    return flash_attention_varlen(
+        q, k, v, cu_seqlens, causal=causal, dropout_p=p,
+        dropout_seed=dropout_seed, interpret=interpret,
+    )
+
+
+class FMHA:
+    """The ``FMHA`` module (``fmha.py:60-80``): holds head geometry +
+    dropout prob, maps ``[total, hidden]`` qkv to heads and back.
+
+    Parameter-free (the projections live in the caller, as in the
+    reference); construct with a BERT-style config or explicit fields.
+    """
+
+    def __init__(self, config=None, *, hidden_size: Optional[int] = None,
+                 num_attention_heads: Optional[int] = None,
+                 attention_probs_dropout_prob: float = 0.0):
+        if config is not None:
+            hidden_size = config.hidden_size
+            num_attention_heads = config.num_attention_heads
+            attention_probs_dropout_prob = getattr(
+                config, "attention_probs_dropout_prob", 0.0)
+        if hidden_size is None or num_attention_heads is None:
+            raise ValueError("need hidden_size and num_attention_heads")
+        self.p_dropout = attention_probs_dropout_prob
+        self.h = num_attention_heads
+        self.hidden_size = hidden_size
+        self.d = hidden_size // self.h
+        if self.d * self.h != hidden_size:
+            raise ValueError("Invalid hidden size/num_heads")
+
+    def __call__(self, qkv: jax.Array, cu_seqlens: jax.Array,
+                 max_s: Optional[int] = None, is_training: bool = True,
+                 zero_tensors: bool = False, *, dropout_seed=None,
+                 interpret: bool = False) -> jax.Array:
+        total = qkv.shape[0]
+        ctx = fmha_varlen(
+            qkv.reshape(total, 3, self.h, self.d), cu_seqlens,
+            self.p_dropout, max_s, is_training, zero_tensors,
+            dropout_seed=dropout_seed, interpret=interpret,
+        )
+        return ctx.reshape(total, self.hidden_size)

@@ -1,14 +1,40 @@
 """OpenFold training pack (reference ``apex/contrib/openfold_triton``).
 
-``FusedAdamSWA`` is the pack's unique capability. The reference's other
-Triton kernels collapse into existing apex_tpu components: ``_mha_kernel``
--> ``apex_tpu.ops.flash_attention`` (same online-softmax attention);
-``_layer_norm_{forward,backward}_kernels`` -> ``apex_tpu.ops.layer_norm``;
-the auto-tune cache sync is CUDA-launch machinery XLA owns.
+- ``FusedAdamSWA`` — fused Adam + stochastic weight averaging
+  (``fused_adam_swa.py``).
+- ``mha`` — pair-biased fused attention, the ``AttnTri`` /
+  ``FusedAttenionCoreFunc`` surface (``mha.py:133``) over the flash
+  kernel's native additive-bias support.
+- ``layer_norm`` — the small-shape LayerNorm entry point
+  (``layer_norm.py:26``) over the Pallas/XLA fused LN.
+
+The reference's Triton auto-tune cache sync (``__init__.py:41-127``) is
+CUDA-launch machinery XLA owns; it has no analogue here.
 """
+from apex_tpu.contrib.openfold import mha  # noqa: F401
 from apex_tpu.contrib.openfold.fused_adam_swa import (  # noqa: F401
     AdamMathType,
     FusedAdamSWA,
 )
+from apex_tpu.contrib.openfold.layer_norm import (  # noqa: F401
+    LayerNormSmallShapeOptImpl,
+    layer_norm_small_shape,
+)
+from apex_tpu.contrib.openfold.mha import (  # noqa: F401
+    AttnTri,
+    attention_core,
+    attention_reference,
+    can_use_fused_attention,
+)
 
-__all__ = ["FusedAdamSWA", "AdamMathType"]
+__all__ = [
+    "FusedAdamSWA",
+    "AdamMathType",
+    "AttnTri",
+    "attention_core",
+    "attention_reference",
+    "can_use_fused_attention",
+    "LayerNormSmallShapeOptImpl",
+    "layer_norm_small_shape",
+    "mha",
+]

@@ -1,0 +1,45 @@
+"""OpenFold small-shape LayerNorm entry point.
+
+Reference: ``apex/contrib/openfold_triton/layer_norm.py`` —
+``LayerNormSmallShapeOptImpl.forward(inputs, normalized_shape, weight,
+bias, eps)`` (``:28``), a Triton kernel pair tuned for the many small
+trailing-dim norms in the Evoformer (plus strided no-copy variants for
+non-contiguous 4-dim inputs; JAX arrays carry no strides, so that split
+disappears here).
+
+The TPU implementation is :func:`apex_tpu.ops.layer_norm.layer_norm`
+(Pallas rows-kernel / XLA dispatch with fp32 row stats) exposed under the
+reference's calling convention: ``normalized_shape`` selects the trailing
+dims to normalise over.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from apex_tpu.ops.layer_norm import layer_norm as _layer_norm
+
+
+def layer_norm_small_shape(
+    inputs: jax.Array,
+    normalized_shape: Sequence[int],
+    weight: jax.Array,
+    bias: jax.Array,
+    eps: float = 1e-5,
+) -> jax.Array:
+    """``LayerNormSmallShapeOptImpl.apply`` analogue (``layer_norm.py:28``)."""
+    normalized_shape = tuple(normalized_shape)
+    nd = len(normalized_shape)
+    if tuple(inputs.shape[-nd:]) != normalized_shape:
+        raise ValueError(
+            f"normalized_shape {normalized_shape} does not match trailing "
+            f"input dims {tuple(inputs.shape[-nd:])}"
+        )
+    return _layer_norm(inputs, weight, bias, normalized_ndim=nd, eps=eps)
+
+
+# reference-named alias (class with .apply in the reference; a plain
+# function here — there is no autograd.Function layer in JAX)
+class LayerNormSmallShapeOptImpl:
+    apply = staticmethod(layer_norm_small_shape)

@@ -1,0 +1,187 @@
+"""OpenFold fused attention — pair-biased MHA on the flash kernel.
+
+Reference: ``apex/contrib/openfold_triton/mha.py`` — the Triton
+``FusedAttenionCoreFunc`` (``:133``, ``AttnTri = ...apply`` ``:397``) takes
+``(q, k, v, mask=None, bias=None, inf, is_training)`` with 4-dim
+``[b, h, n, d]`` or 5-dim ``[1, b, h, n, d]`` operands, a {0,1} logit mask
+applied additively as ``(mask - 1) * inf``, and an additive pair-bias
+broadcastable to ``[b, h, n, n]`` (the AlphaFold triangle/row attention
+shape); eager fallbacks ``_attention_bias``/``_attention_no_bias``
+(``:400-466``); ``CanSchTriMHA`` schedule gate (``:36``) and module-level
+``enable``/``disable``/``is_enabled`` toggles (``:20-33``).
+
+Here the core is :func:`apex_tpu.ops.flash_attention.flash_attention` with
+its native additive-bias support — same online-softmax tiles, no [n, n]
+score tensor, dbias via the tile-wise backward — instead of a separate
+Triton kernel family. The {0,1} mask folds into the kernel's key-padding
+mask when it is key-only (``[b, 1, 1, K]``-broadcastable); a general mask
+folds into the additive bias exactly as the reference does.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_available,
+)
+
+_enabled: Optional[bool] = None
+
+
+def is_enabled() -> Optional[bool]:
+    """Mirror of the reference's module toggle (``mha.py:20``)."""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def can_use_fused_attention(
+    in_shape, has_bias: bool = True, training: bool = True,
+    interpret: bool = False,
+) -> bool:
+    """Availability gate, the ``CanSchTriMHA`` analogue (``mha.py:36``):
+    the reference checks head-dim ∈ {16,32,64,128} and its schedule table;
+    here the flash kernel's own tileability gate decides."""
+    del has_bias, training  # the flash kernel handles both uniformly
+    n, d = in_shape[-2], in_shape[-1]
+    return flash_attention_available(n, n, d, interpret=interpret)
+
+
+def _to_bnsd(x):
+    """[*, h, n, d] with 4 or 5 dims -> ([b, h, n, d], had_5dim)."""
+    if x.ndim == 5:
+        if x.shape[0] != 1:
+            raise ValueError(
+                f"5-dim operands must have a leading 1 dim, got {x.shape}"
+            )
+        return x[0], True
+    if x.ndim != 4:
+        raise ValueError(f"expected 4- or 5-dim operand, got {x.shape}")
+    return x, False
+
+
+def attention_core(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    inf: float = 1e9,
+    is_training: bool = True,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """The ``AttnTri`` / ``FusedAttenionCoreFunc`` analogue.
+
+    ``mask`` is {0,1} (1 = attend), broadcastable to ``[b, h, q, k]`` —
+    typically the AlphaFold ``[b, 1, 1, k]`` key mask; ``bias`` is an
+    additive logit bias broadcastable to ``[b, h, q, k]``. Differentiable
+    in q/k/v/bias (like the reference, which returns dB but no dmask).
+    """
+    del is_training  # dropout-free core, as in the reference kernel
+    q, had5 = _to_bnsd(q)
+    k, _ = _to_bnsd(k)
+    v, _ = _to_bnsd(v)
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+
+    def _drop5(x, what):
+        if x.ndim == 5:
+            if x.shape[0] != 1:
+                raise ValueError(
+                    f"5-dim {what} must have a leading 1 dim, got {x.shape}"
+                )
+            return x[0]
+        return x
+
+    kv_mask = None
+    mask_bias = None
+    if mask is not None:
+        mask = _drop5(mask, "mask")
+        # key-only masks ride the kernel's native padding-mask input;
+        # anything else becomes additive logits, as the reference does
+        # with (mask - 1) * inf
+        if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+            kv_mask = jnp.broadcast_to(mask[:, 0, 0, :], (b, s_k))
+        else:
+            m = jnp.broadcast_to(mask, (b, h, s_q, s_k)).astype(jnp.float32)
+            mask_bias = (m - 1.0) * inf
+    add_bias = mask_bias
+    if bias is not None:
+        bias = _drop5(bias, "bias")
+        while bias.ndim < 4:
+            bias = bias[None]
+        # the kernel broadcasts batch/head dims itself; q/k dims must be
+        # materialised (a [.., 1, k] per-key bias is legal here)
+        bias = jnp.broadcast_to(
+            bias, bias.shape[:2] + (s_q, s_k)
+        )
+        if add_bias is None:
+            add_bias = bias
+        else:
+            add_bias = add_bias + jnp.broadcast_to(
+                bias, (b, h, s_q, s_k)
+            ).astype(jnp.float32)
+
+    o = flash_attention(
+        q, k, v, bias=add_bias, kv_mask=kv_mask,
+        # only a user-supplied bias carries gradients (the reference
+        # returns dB but no dmask); a folded mask alone skips the O(s^2)
+        # dbias emission in the backward
+        bias_grad=bias is not None,
+        interpret=interpret,
+    )
+    return o[None] if had5 else o
+
+
+# reference alias (``AttnTri = FusedAttenionCoreFunc.apply``, mha.py:397)
+AttnTri = attention_core
+
+
+def attention_reference(
+    q, k, v, mask=None, bias=None, inf: float = 1e9
+) -> jax.Array:
+    """Eager math (``_attention_bias``/``_attention_no_bias``,
+    ``mha.py:400-466``) for tests: softmax(q@k.T/sqrt(d) + (mask-1)*inf
+    [+ bias]) @ v."""
+    q, had5 = _to_bnsd(q)
+    k, _ = _to_bnsd(k)
+    v, _ = _to_bnsd(v)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    a = jnp.einsum(
+        "bhqd,bhkd->bhqk", q * scale, k, preferred_element_type=jnp.float32
+    )
+    if mask is not None:
+        if mask.ndim == 5:
+            if mask.shape[0] != 1:
+                raise ValueError(
+                    f"5-dim mask must have a leading 1 dim, got {mask.shape}"
+                )
+            mask = mask[0]
+        a = a + (mask.astype(jnp.float32) - 1.0) * inf
+    if bias is not None:
+        if bias.ndim == 5:
+            if bias.shape[0] != 1:
+                raise ValueError(
+                    f"5-dim bias must have a leading 1 dim, got {bias.shape}"
+                )
+            bias = bias[0]
+        a = a + bias.astype(jnp.float32)
+    a = jax.nn.softmax(a, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", a.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    return o[None] if had5 else o

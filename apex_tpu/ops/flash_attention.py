@@ -25,7 +25,13 @@ tokens only attend within their own sequence.
 
 Supports: causal masking (block-skipped: tiles strictly above the diagonal
 are neither loaded nor computed), a key-padding mask ``[b, s_k]`` (True =
-attend), softmax scale, and **in-kernel attention dropout**: the keep mask
+attend), an **additive logit bias** ``[b|1, n|1, s_q, s_k]`` streamed in
+``[block_q, block_k]`` tiles (never fully VMEM-resident) with gradients —
+the AlphaFold pair bias / ALiBi / T5 relative-position case, and the
+capability behind the reference's openfold MHA
+(``apex/contrib/openfold_triton/mha.py:133`` takes ``bias=``) and the
+``multihead_attn`` additive-mask variants — softmax scale, and
+**in-kernel attention dropout**: the keep mask
 is a counter-based hash of ``(seed, head, global_q, global_k)`` computed in
 plain vector ops inside each tile — the Philox analogue of the reference
 ``fmha``/``multihead_attn`` kernels — so the forward never materialises the
@@ -62,6 +68,20 @@ def _pick_block(s: int, want: int) -> int:
     for cand in (want, 1024, 512, 256, 128, 64, 32, 16, 8):
         if cand <= want and s % cand == 0:
             return cand
+    return s
+
+
+def _lane_block(s: int, blk: int) -> int:
+    """Constrain a block that lands on the LANE dim of a mask/segment/bias
+    BlockSpec: Mosaic requires lane-dim block sizes to be a multiple of
+    128 or equal to the whole array dim. Returns the divisor of ``s``
+    among (128, 256, 512, 1024) closest to the requested block, else the
+    whole dim (always legal)."""
+    if blk % 128 == 0 or blk == s:
+        return blk
+    cands = [c for c in (128, 256, 512, 1024) if s % c == 0]
+    if cands:
+        return min(cands, key=lambda c: abs(c - blk))
     return s
 
 
@@ -177,10 +197,10 @@ def _mask_scores(s, qi, ki, *, causal, have_mask, mask_ref, have_segs,
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, mask_ref, segq_ref, segk_ref, seed_ref,
+    q_ref, k_ref, v_ref, bias_ref, mask_ref, segq_ref, segk_ref, seed_ref,
     o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k, n_k, n_heads, have_mask, have_segs,
-    dropout_p,
+    *, scale, causal, block_q, block_k, n_k, n_heads, have_bias, have_mask,
+    have_segs, dropout_p,
 ):
     ib, ih = pl.program_id(0), pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
@@ -201,6 +221,8 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
+        if have_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
 
         qi, ki = _tile_indices(iq, ik, block_q, block_k)
         s = _mask_scores(
@@ -265,17 +287,48 @@ def _seg_args(segments, s):
     return arr, have
 
 
+def _bias_args(bias, bq, bk, kmajor):
+    """(array, spec, have) for the optional additive-bias input
+    ``[b|1, n|1, s_q, s_k]``; broadcast batch/head dims pin their block
+    index to 0. ``kmajor`` selects the (ik, iq) grid order of the dkv
+    backward kernel."""
+    have = bias is not None
+    if not have:
+        arr = jnp.zeros((1, 1, 8, 128), jnp.float32)
+        return arr, pl.BlockSpec(
+            (1, 1, 8, 128), lambda ib, ih, i2, i3: (0, 0, 0, 0)
+        ), False
+    bb, bn = bias.shape[0], bias.shape[1]
+    if kmajor:
+        im = lambda ib, ih, ik, iq: (
+            ib if bb > 1 else 0, ih if bn > 1 else 0, iq, ik)
+    else:
+        im = lambda ib, ih, iq, ik: (
+            ib if bb > 1 else 0, ih if bn > 1 else 0, iq, ik)
+    return bias, pl.BlockSpec((1, 1, bq, bk), im), True
+
+
 def _fwd(
-    q, k, v, kv_mask, seg_q, seg_k, seed, scale, causal, dropout_p,
+    q, k, v, bias, kv_mask, seg_q, seg_k, seed, scale, causal, dropout_p,
     block_q, block_k, interpret,
 ):
     b, n, s_q, d = q.shape
     s_k = k.shape[2]
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_k, block_k)
+    have_bias = bias is not None
+    have_mask = kv_mask is not None
+    if not interpret:
+        # mask/seg/bias blocks put bq/bk on a lane dim (Mosaic: %128 or
+        # whole-dim); interpret mode skips this so CPU tests can exercise
+        # small multi-tile configs
+        if seg_q is not None:
+            bq = _lane_block(s_q, bq)
+        if have_mask or have_bias or seg_k is not None:
+            bk = _lane_block(s_k, bk)
     n_q, n_k = s_q // bq, s_k // bk
 
-    have_mask = kv_mask is not None
+    bias_arg, bias_spec, _ = _bias_args(bias, bq, bk, False)
     mask_arg = (
         kv_mask.astype(jnp.int8).reshape(b, 1, s_k)
         if have_mask
@@ -304,8 +357,8 @@ def _fwd(
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
-        n_heads=n, have_mask=have_mask, have_segs=have_segs,
-        dropout_p=dropout_p,
+        n_heads=n, have_bias=have_bias, have_mask=have_mask,
+        have_segs=have_segs, dropout_p=dropout_p,
     )
     grid = (b, n, n_q, n_k)
     out_shape = [
@@ -324,6 +377,7 @@ def _fwd(
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            bias_spec,
             mask_spec,
             segq_spec,
             segk_spec,
@@ -339,7 +393,7 @@ def _fwd(
         scratch_shapes=scratch,
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, mask_arg, segq_arg, segk_arg, seed_arg)
+    )(q, k, v, bias_arg, mask_arg, segq_arg, segk_arg, seed_arg)
     return o, lse[..., 0]  # lse [b, n, s_q]
 
 
@@ -357,17 +411,25 @@ def _compiler_params():
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-    segq_ref, segk_ref, seed_ref, dq_ref, acc_scr,
-    *, scale, causal, block_q, block_k, n_k, n_heads, have_mask, have_segs,
-    dropout_p,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, mask_ref,
+    segq_ref, segk_ref, seed_ref, dq_ref, *rest,
+    scale, causal, block_q, block_k, n_k, n_heads, have_bias, emit_dbias,
+    have_mask, have_segs, dropout_p,
 ):
+    # with dbias: rest = (dbias_ref, acc_scr); without: rest = (acc_scr,)
+    dbias_ref = rest[0] if emit_dbias else None
+    acc_scr = rest[-1]
     ib, ih = pl.program_id(0), pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    if emit_dbias:
+        # each (iq, ik) block is visited exactly once; causal-skipped tiles
+        # keep this zero fill
+        dbias_ref[0, 0] = jnp.zeros_like(dbias_ref[0, 0])
 
     def compute():
         q = q_ref[0, 0]
@@ -376,6 +438,8 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        if have_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         qi, ki = _tile_indices(iq, ik, block_q, block_k)
         s = _mask_scores(
             s, qi, ki, causal=causal, have_mask=have_mask, mask_ref=mask_ref,
@@ -396,6 +460,10 @@ def _bwd_dq_kernel(
             dp = dp * keep * (1.0 / (1.0 - dropout_p))
         delta = delta_ref[0, 0][:, :1]
         ds = p * (dp - delta)
+        if emit_dbias:
+            # d(logits): the bias enters the logits additively, so its grad
+            # is ds itself (per [bq, bk] tile; broadcast dims summed in XLA)
+            dbias_ref[0, 0] = ds.astype(dbias_ref.dtype)
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0, 0],
             (((1,), (0,)), ((), ())),
@@ -415,10 +483,10 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, mask_ref,
     segq_ref, segk_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, n_q, n_heads, have_mask, have_segs,
-    dropout_p,
+    *, scale, causal, block_q, block_k, n_q, n_heads, have_bias, have_mask,
+    have_segs, dropout_p,
 ):
     ib, ih = pl.program_id(0), pl.program_id(1)
     ik, iq = pl.program_id(2), pl.program_id(3)
@@ -435,6 +503,8 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
+        if have_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         qi, ki = _tile_indices(iq, ik, block_q, block_k)
         s = _mask_scores(
             s, qi, ki, causal=causal, have_mask=have_mask, mask_ref=mask_ref,
@@ -485,14 +555,26 @@ def _bwd_dkv_kernel(
 
 
 def _bwd(
-    q, k, v, kv_mask, seg_q, seg_k, seed, o, lse, do, scale, causal,
-    dropout_p, block_q, block_k, interpret,
+    q, k, v, bias, kv_mask, seg_q, seg_k, seed, o, lse, do, scale, causal,
+    dropout_p, block_q, block_k, interpret, bias_grad,
 ):
     b, n, s_q, d = q.shape
     s_k = k.shape[2]
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_k, block_k)
+    have_bias = bias is not None
+    have_mask = kv_mask is not None
+    if not interpret:
+        # same lane-dim constraint as the forward (see _lane_block)
+        if seg_q is not None:
+            bq = _lane_block(s_q, bq)
+        if have_mask or have_bias or seg_k is not None:
+            bk = _lane_block(s_k, bk)
     n_q, n_k = s_q // bq, s_k // bk
+    # the dq kernel only emits the O(s^2) dbias buffer when the bias
+    # actually needs a gradient (bias_grad=False: ALiBi slopes, folded
+    # masks — constants whose cotangent would be discarded)
+    emit_dbias = have_bias and bias_grad
 
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -502,7 +584,6 @@ def _bwd(
     lse_b = lse[..., None]
     delta_b = delta[..., None]
 
-    have_mask = kv_mask is not None
     mask_arg = (
         kv_mask.astype(jnp.int8).reshape(b, 1, s_k)
         if have_mask
@@ -548,12 +629,28 @@ def _bwd(
     k_spec = lambda im: pl.BlockSpec((1, 1, bk, d), im)
     row_spec = lambda im: pl.BlockSpec((1, 1, bq, 1), im)
 
-    dq = pl.pallas_call(
+    bias_q, bias_spec_q, _ = _bias_args(bias, bq, bk, False)
+    bias_k, bias_spec_k, _ = _bias_args(bias, bq, bk, True)
+
+    dq_out_specs = [q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0))]
+    dq_out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if emit_dbias:
+        # dbias comes out FULL [b, n, s_q, s_k] (each grid step owns one
+        # (iq, ik) tile); broadcast input dims are reduced by the caller.
+        # O(s^2) memory, but only on backward and only when the bias itself
+        # is an input that needs a gradient — the same cost torch autograd
+        # pays for an expanded bias in the reference openfold kernels.
+        dq_out_specs.append(pl.BlockSpec(
+            (1, 1, bq, bk), lambda ib, ih, iq, ik: (ib, ih, iq, ik)))
+        dq_out_shape.append(
+            jax.ShapeDtypeStruct((b, n, s_q, s_k), jnp.float32))
+
+    dq_res = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel,
             scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
-            n_heads=n, have_mask=have_mask, have_segs=have_segs,
-            dropout_p=dropout_p,
+            n_heads=n, have_bias=have_bias, emit_dbias=emit_dbias,
+            have_mask=have_mask, have_segs=have_segs, dropout_p=dropout_p,
         ),
         grid=(b, n, n_q, n_k),
         in_specs=[
@@ -563,24 +660,30 @@ def _bwd(
             q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            bias_spec_q,
             mask_spec(False),
             segq_spec(False),
             segk_spec(False),
             seed_spec,
         ],
-        out_specs=q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=dq_out_specs if emit_dbias else dq_out_specs[0],
+        out_shape=dq_out_shape if emit_dbias else dq_out_shape[0],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, mask_arg, segq_arg, segk_arg, seed_arg)
+    )(q, k, v, do, lse_b, delta_b, bias_q, mask_arg, segq_arg, segk_arg,
+      seed_arg)
+    if emit_dbias:
+        dq, dbias_full = dq_res
+    else:
+        dq, dbias_full = dq_res, None
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
             scale=scale, causal=causal, block_q=bq, block_k=bk, n_q=n_q,
-            n_heads=n, have_mask=have_mask, have_segs=have_segs,
-            dropout_p=dropout_p,
+            n_heads=n, have_bias=have_bias, have_mask=have_mask,
+            have_segs=have_segs, dropout_p=dropout_p,
         ),
         grid=(b, n, n_k, n_q),
         in_specs=[
@@ -590,6 +693,7 @@ def _bwd(
             q_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            bias_spec_k,
             mask_spec(True),
             segq_spec(True),
             segk_spec(True),
@@ -609,8 +713,9 @@ def _bwd(
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, mask_arg, segq_arg, segk_arg, seed_arg)
-    return dq, dk, dv
+    )(q, k, v, do, lse_b, delta_b, bias_k, mask_arg, segq_arg, segk_arg,
+      seed_arg)
+    return dq, dk, dv, dbias_full
 
 
 # ---------------------------------------------------------------------------
@@ -619,34 +724,48 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11)
+    jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13)
 )
-def _flash(q, k, v, kv_mask, segs, seed, scale, causal, dropout_p, block_q,
-           block_k, interpret):
+def _flash(q, k, v, bias, kv_mask, segs, seed, scale, causal, dropout_p,
+           block_q, block_k, interpret, bias_grad=True):
     seg_q, seg_k = segs if segs is not None else (None, None)
-    o, _ = _fwd(q, k, v, kv_mask, seg_q, seg_k, seed, scale, causal,
+    o, _ = _fwd(q, k, v, bias, kv_mask, seg_q, seg_k, seed, scale, causal,
                 dropout_p, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, kv_mask, segs, seed, scale, causal, dropout_p,
-               block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, kv_mask, segs, seed, scale, causal, dropout_p,
+               block_q, block_k, interpret, bias_grad=True):
     seg_q, seg_k = segs if segs is not None else (None, None)
     o, lse = _fwd(
-        q, k, v, kv_mask, seg_q, seg_k, seed, scale, causal, dropout_p,
+        q, k, v, bias, kv_mask, seg_q, seg_k, seed, scale, causal, dropout_p,
         block_q, block_k, interpret,
     )
-    return o, (q, k, v, kv_mask, segs, seed, o, lse)
+    return o, (q, k, v, bias, kv_mask, segs, seed, o, lse)
 
 
-def _flash_bwd(scale, causal, dropout_p, block_q, block_k, interpret, res, do):
-    q, k, v, kv_mask, segs, seed, o, lse = res
+def _flash_bwd(scale, causal, dropout_p, block_q, block_k, interpret,
+               bias_grad, res, do):
+    q, k, v, bias, kv_mask, segs, seed, o, lse = res
     seg_q, seg_k = segs if segs is not None else (None, None)
-    dq, dk, dv = _bwd(
-        q, k, v, kv_mask, seg_q, seg_k, seed, o, lse, do, scale, causal,
-        dropout_p, block_q, block_k, interpret,
+    dq, dk, dv, dbias_full = _bwd(
+        q, k, v, bias, kv_mask, seg_q, seg_k, seed, o, lse, do, scale,
+        causal, dropout_p, block_q, block_k, interpret, bias_grad,
     )
-    return dq, dk, dv, None, None, None
+    dbias = None
+    if bias is not None:
+        if dbias_full is None:
+            # bias_grad=False: a constant bias whose cotangent the caller
+            # discards — return symbolic zeros without the O(s^2) buffer
+            dbias = jnp.zeros(bias.shape, bias.dtype)
+        else:
+            dbias = dbias_full
+            if bias.shape[0] == 1:
+                dbias = dbias.sum(axis=0, keepdims=True)
+            if bias.shape[1] == 1:
+                dbias = dbias.sum(axis=1, keepdims=True)
+            dbias = dbias.astype(bias.dtype)
+    return dq, dk, dv, dbias, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -674,6 +793,8 @@ def flash_attention(
     *,
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,  # [b, s_k]; True/nonzero = attend
+    bias: Optional[jax.Array] = None,  # [b|1, n|1, s_q, s_k] added to logits
+    bias_grad: bool = True,
     scale: Optional[float] = None,
     dropout_p: float = 0.0,
     dropout_seed=None,  # int or int32 scalar; required when dropout_p > 0
@@ -683,15 +804,38 @@ def flash_attention(
 ) -> jax.Array:
     """Tiled online-softmax attention, O(s) memory per row block.
 
-    Returns ``dropout(softmax(q @ k.T * scale [masked])) @ v`` in
+    Returns ``dropout(softmax(q @ k.T * scale + bias [masked])) @ v`` in
     ``q.dtype`` without materialising the score tensor. Differentiable
     (custom VJP recomputes score tiles from the saved logsumexp; the
     dropout mask is regenerated in-kernel from the same hash counters).
+
+    ``bias`` is an additive logit bias (AlphaFold pair bias / ALiBi / T5
+    relative positions; the reference openfold MHA's ``bias=`` argument,
+    ``apex/contrib/openfold_triton/mha.py:133``): batch/head dims may be 1
+    (broadcast). It is streamed tile-by-tile in the forward; its gradient
+    materialises one fp32 ``[b, n, s_q, s_k]`` buffer in the backward
+    (reduced over broadcast dims). Pass ``bias_grad=False`` for a constant
+    bias (ALiBi slopes, a folded mask): the backward then skips the O(s^2)
+    dbias emission entirely and the bias cotangent is zeros.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if kv_mask is not None:
         kv_mask = kv_mask.astype(jnp.int8)
+    if bias is not None:
+        b, n, s_q = q.shape[0], q.shape[1], q.shape[2]
+        s_k = k.shape[2]
+        if (bias.ndim != 4 or bias.shape[0] not in (1, b)
+                or bias.shape[1] not in (1, n)
+                or bias.shape[2:] != (s_q, s_k)):
+            raise ValueError(
+                f"bias shape {bias.shape} must be [b|1, n|1, s_q, s_k] = "
+                f"[{b}|1, {n}|1, {s_q}, {s_k}]"
+            )
+        # a [1024, 1024] fp32 score tile + bias tile + dbias tile would
+        # crowd VMEM; cap blocks at 512 when a bias is present
+        block_q = min(block_q, 512)
+        block_k = min(block_k, 512)
     seed = _resolve_seed(dropout_p, dropout_seed)
     # kernel dots run in the operand dtype (MXU-native); normalise mixed
     # inputs to q's dtype so e.g. (fp32 q, bf16 k/v) still compiles
@@ -702,8 +846,9 @@ def flash_attention(
     if not interpret and jax.default_backend() != "tpu":
         interpret = True
     return _flash(
-        q, k, v, kv_mask, None, seed, float(scale), bool(causal),
+        q, k, v, bias, kv_mask, None, seed, float(scale), bool(causal),
         float(dropout_p), int(block_q), int(block_k), bool(interpret),
+        bool(bias_grad),
     )
 
 
@@ -767,15 +912,16 @@ def flash_attention_varlen(
     if not interpret and jax.default_backend() != "tpu":
         interpret = True
     o = _flash(
-        qb, kb, vb, None, (segs, segs), seed, float(scale), bool(causal),
-        float(dropout_p), int(block_q), int(block_k), bool(interpret),
+        qb, kb, vb, None, None, (segs, segs), seed, float(scale),
+        bool(causal), float(dropout_p), int(block_q), int(block_k),
+        bool(interpret),
     )
     return o[0].transpose(1, 0, 2)  # [total, n, d]
 
 
 def mha_reference(
-    q, k, v, *, causal=False, kv_mask=None, scale=None, dropout_p=0.0,
-    dropout_seed=None,
+    q, k, v, *, causal=False, kv_mask=None, bias=None, scale=None,
+    dropout_p=0.0, dropout_seed=None,
 ) -> jax.Array:
     """Materialised-score reference (for tests): same math, O(s^2) — incl.
     the kernels' exact hash-dropout mask and the zeros-for-fully-masked-rows
@@ -785,6 +931,8 @@ def mha_reference(
     s = jnp.einsum(
         "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2:]
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)

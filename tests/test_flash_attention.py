@@ -358,3 +358,92 @@ def test_gpt_flash_with_attention_dropout():
     g = jax.grad(lambda p: gpt_loss(cfg, p, tokens, labels, dropout_key=k,
                                     deterministic=False))(params)
     assert all(jnp.isfinite(x).all() for x in jax.tree_util.tree_leaves(g))
+
+
+# ---------------------------------------------------------------------------
+# additive logit bias (AlphaFold pair bias / ALiBi; reference openfold MHA's
+# ``bias=`` argument, apex/contrib/openfold_triton/mha.py:133)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bias_shape", [(2, 2, 64, 64), (1, 2, 64, 64),
+                                        (2, 1, 64, 64), (1, 1, 64, 64)])
+def test_flash_bias_forward_matches_reference(causal, bias_shape):
+    key = jax.random.PRNGKey(11)
+    q, k, v = _qkv(key)
+    bias = jax.random.normal(jax.random.fold_in(key, 1), bias_shape) * 0.5
+    o = flash_attention(q, k, v, bias=bias, causal=causal,
+                        block_q=16, block_k=16)
+    ref = mha_reference(q, k, v, bias=bias, causal=causal)
+    assert jnp.abs(o - ref).max() < 2e-5
+
+
+@pytest.mark.parametrize("bias_shape", [(2, 2, 64, 64), (1, 2, 64, 64)])
+def test_flash_bias_grads_match_reference(bias_shape):
+    """dq/dk/dv/dbias vs the materialised reference — incl. the broadcast
+    reduction of dbias over a collapsed batch dim."""
+    key = jax.random.PRNGKey(12)
+    q, k, v = _qkv(key)
+    bias = jax.random.normal(jax.random.fold_in(key, 2), bias_shape) * 0.5
+
+    def loss(fn):
+        return lambda q, k, v, bias: jnp.sum(fn(q, k, v, bias) ** 2)
+
+    gf = jax.grad(
+        loss(lambda q, k, v, b: flash_attention(
+            q, k, v, bias=b, block_q=16, block_k=16)),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, bias)
+    gr = jax.grad(
+        loss(lambda q, k, v, b: mha_reference(q, k, v, bias=b)),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, bias)
+    for a, b in zip(gf, gr):
+        assert a.shape == b.shape
+        assert jnp.abs(a - b).max() < 5e-4
+
+
+def test_flash_bias_causal_grads_zero_above_diagonal():
+    """Causal-skipped tiles must leave dbias zero-filled (the dq kernel
+    writes the zero block before the masked compute)."""
+    key = jax.random.PRNGKey(13)
+    q, k, v = _qkv(key, s=64)
+    bias = jax.random.normal(jax.random.fold_in(key, 3), (2, 2, 64, 64))
+    db = jax.grad(
+        lambda b: jnp.sum(flash_attention(
+            q, k, v, bias=b, causal=True, block_q=16, block_k=16) ** 2)
+    )(bias)
+    qi = jnp.arange(64)[:, None]
+    ki = jnp.arange(64)[None, :]
+    above = jnp.broadcast_to(ki > qi, db.shape)
+    assert jnp.abs(jnp.where(above, db, 0.0)).max() == 0.0
+
+
+def test_flash_bias_with_dropout_matches_reference():
+    key = jax.random.PRNGKey(14)
+    q, k, v = _qkv(key)
+    bias = jax.random.normal(jax.random.fold_in(key, 4), (1, 2, 64, 64)) * 0.3
+    o = flash_attention(q, k, v, bias=bias, dropout_p=0.2, dropout_seed=21,
+                        block_q=16, block_k=16)
+    ref = mha_reference(q, k, v, bias=bias, dropout_p=0.2, dropout_seed=21)
+    assert jnp.abs(o - ref).max() < 2e-5
+
+
+def test_flash_bias_shape_validation():
+    q, k, v = _qkv(jax.random.PRNGKey(15))
+    with pytest.raises(ValueError, match="bias shape"):
+        flash_attention(q, k, v, bias=jnp.zeros((3, 2, 64, 64)))
+    with pytest.raises(ValueError, match="bias shape"):
+        flash_attention(q, k, v, bias=jnp.zeros((2, 2, 32, 64)))
+
+
+def test_lane_block_picks():
+    """Mosaic lane-dim rule for mask/seg/bias blocks: %128 or whole dim
+    (regression for varlen totals like 320 failing to lower on TPU)."""
+    from apex_tpu.ops.flash_attention import _lane_block
+    assert _lane_block(320, 64) == 320      # no %128 divisor -> whole dim
+    assert _lane_block(384, 64) == 128      # closest %128 divisor
+    assert _lane_block(1024, 512) == 512    # already legal
+    assert _lane_block(1024, 1024) == 1024  # whole dim always legal
+    assert _lane_block(72, 8) == 72         # small odd seq -> whole dim

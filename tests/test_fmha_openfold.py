@@ -1,0 +1,196 @@
+"""contrib.fmha (packed-qkv varlen MHA) and contrib.openfold (pair-biased
+attention + small-shape LayerNorm) vs eager references.
+
+Mirrors the reference contrib test style (``apex/contrib/test/fmha/``,
+the openfold_triton README's parity checks).
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.contrib.fmha import FMHA, fmha_varlen
+from apex_tpu.contrib.openfold import (
+    AttnTri,
+    LayerNormSmallShapeOptImpl,
+    attention_core,
+    attention_reference,
+    can_use_fused_attention,
+    layer_norm_small_shape,
+)
+from apex_tpu.ops.flash_attention import mha_reference_varlen
+
+
+# ---------------------------------------------------------------------------
+# contrib.fmha
+# ---------------------------------------------------------------------------
+
+
+def _packed_qkv(key, lens, h=2, d=16):
+    total = sum(lens)
+    qkv = jax.random.normal(key, (total, 3, h, d))
+    cu = jnp.asarray([0] + list(jnp.cumsum(jnp.asarray(lens))), jnp.int32)
+    return qkv, cu, total
+
+
+def test_fmha_varlen_matches_per_sequence_reference():
+    qkv, cu, total = _packed_qkv(jax.random.PRNGKey(0), [24, 40, 16])
+    out = fmha_varlen(qkv, cu)
+    ref = mha_reference_varlen(qkv[:, 0], qkv[:, 1], qkv[:, 2], cu)
+    assert out.shape == (total, 2, 16)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_fmha_module_hidden_layout_roundtrip():
+    h, d = 2, 16
+    hidden = h * d
+    qkv, cu, total = _packed_qkv(jax.random.PRNGKey(1), [32, 32], h=h, d=d)
+    mod = FMHA(hidden_size=hidden, num_attention_heads=h)
+    out = mod(qkv.reshape(total, 3 * hidden), cu)
+    ref = mha_reference_varlen(qkv[:, 0], qkv[:, 1], qkv[:, 2], cu)
+    assert out.shape == (total, hidden)
+    assert jnp.abs(out - ref.reshape(total, hidden)).max() < 2e-5
+
+
+def test_fmha_dropout_inference_mode_off():
+    """is_training=False disables dropout like the reference fmha."""
+    qkv, cu, _ = _packed_qkv(jax.random.PRNGKey(2), [16, 16])
+    mod = FMHA(hidden_size=32, num_attention_heads=2,
+               attention_probs_dropout_prob=0.5)
+    total = qkv.shape[0]
+    flat = qkv.reshape(total, 96)
+    o_eval = mod(flat, cu, is_training=False)
+    o_eval2 = mod(flat, cu, is_training=False)
+    assert jnp.abs(o_eval - o_eval2).max() == 0.0
+    o_train = mod(flat, cu, is_training=True, dropout_seed=3)
+    assert jnp.abs(o_train - o_eval).max() > 0.0
+
+
+def test_fmha_bad_qkv_shape():
+    with pytest.raises(ValueError, match="total, 3, h, d"):
+        fmha_varlen(jnp.zeros((8, 2, 2, 4)), jnp.asarray([0, 8]))
+
+
+# ---------------------------------------------------------------------------
+# contrib.openfold attention
+# ---------------------------------------------------------------------------
+
+
+def test_openfold_attention_bias_matches_reference():
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 4)
+    b, h, n, d = 3, 2, 32, 16
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, d)) for i in range(3))
+    bias = jax.random.normal(ks[3], (1, h, n, n)) * 0.5
+    out = attention_core(q, k, v, bias=bias)
+    ref = attention_reference(q, k, v, bias=bias)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_openfold_attention_mask_and_bias_5dim():
+    """The AlphaFold calling shape: [1, b, h, n, d] operands, [b, 1, 1, n]
+    key mask, [1, h, n, n] pair bias."""
+    key = jax.random.PRNGKey(4)
+    ks = jax.random.split(key, 5)
+    b, h, n, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(ks[i], (1, b, h, n, d)) for i in range(3))
+    mask = jax.random.bernoulli(ks[3], 0.8, (b, 1, 1, n)).astype(jnp.float32)
+    # keep at least one key per row alive (fully-masked rows follow the
+    # flash kernel's zeros convention, not softmax-of-all--inf)
+    mask = mask.at[:, :, :, 0].set(1.0)
+    bias = jax.random.normal(ks[4], (h, n, n))[None] * 0.3
+    out = AttnTri(q, k, v, mask, bias, 1e9)
+    ref = attention_reference(q, k, v, mask=mask, bias=bias)
+    assert out.shape == (1, b, h, n, d)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_openfold_attention_bias_grads():
+    key = jax.random.PRNGKey(5)
+    ks = jax.random.split(key, 4)
+    b, h, n, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, d)) for i in range(3))
+    bias = jax.random.normal(ks[3], (1, h, n, n)) * 0.5
+
+    gf = jax.grad(lambda bb: jnp.sum(attention_core(q, k, v, bias=bb) ** 2))(bias)
+    gr = jax.grad(lambda bb: jnp.sum(attention_reference(q, k, v, bias=bb) ** 2))(bias)
+    assert gf.shape == bias.shape
+    assert jnp.abs(gf - gr).max() < 5e-4
+
+
+def test_openfold_can_use_fused_attention():
+    assert isinstance(can_use_fused_attention((2, 2, 32, 16), True, True,
+                                              interpret=True), bool)
+
+
+# ---------------------------------------------------------------------------
+# contrib.openfold layer norm
+# ---------------------------------------------------------------------------
+
+
+def test_openfold_layer_norm_matches_jax():
+    key = jax.random.PRNGKey(6)
+    x = jax.random.normal(key, (4, 8, 32))
+    w = jax.random.normal(jax.random.fold_in(key, 1), (32,)) + 1.0
+    b = jax.random.normal(jax.random.fold_in(key, 2), (32,))
+    y = layer_norm_small_shape(x, (32,), w, b)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / jnp.sqrt(var + 1e-5) * w + b
+    assert jnp.abs(y - ref).max() < 1e-5
+    # reference-named .apply alias
+    y2 = LayerNormSmallShapeOptImpl.apply(x, (32,), w, b)
+    assert jnp.abs(y - y2).max() == 0.0
+
+
+def test_openfold_layer_norm_shape_validation():
+    with pytest.raises(ValueError, match="normalized_shape"):
+        layer_norm_small_shape(jnp.zeros((4, 8)), (16,), jnp.ones(16),
+                               jnp.zeros(16))
+
+
+def test_openfold_attention_per_key_bias_broadcasts():
+    """A [.., 1, k] per-key bias (docstring-legal, broadcast over q) must
+    work — the wrapper materialises the q/k dims before the kernel."""
+    key = jax.random.PRNGKey(7)
+    ks = jax.random.split(key, 4)
+    b, h, n, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, d)) for i in range(3))
+    bias = jax.random.normal(ks[3], (1, h, 1, n)) * 0.5
+    out = attention_core(q, k, v, bias=bias)
+    ref = attention_reference(q, k, v, bias=bias)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_openfold_attention_5dim_leading_dim_validated():
+    key = jax.random.PRNGKey(8)
+    ks = jax.random.split(key, 4)
+    b, h, n, d = 2, 2, 16, 16
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, d)) for i in range(3))
+    bad_mask = jnp.ones((2, b, 1, 1, n))
+    with pytest.raises(ValueError, match="leading 1 dim"):
+        attention_core(q, k, v, mask=bad_mask)
+    with pytest.raises(ValueError, match="leading 1 dim"):
+        attention_reference(q, k, v, mask=bad_mask)
+
+
+def test_flash_bias_grad_false_returns_zeros():
+    """bias_grad=False: constant-bias cotangent is zeros and fwd output is
+    identical to bias_grad=True."""
+    from apex_tpu.ops.flash_attention import flash_attention
+    key = jax.random.PRNGKey(9)
+    ks = jax.random.split(key, 4)
+    q, k, v = (jax.random.normal(ks[i], (2, 2, 32, 16)) for i in range(3))
+    bias = jax.random.normal(ks[3], (1, 2, 32, 32))
+    o1 = flash_attention(q, k, v, bias=bias, block_q=16, block_k=16)
+    o2 = flash_attention(q, k, v, bias=bias, bias_grad=False,
+                         block_q=16, block_k=16)
+    assert jnp.abs(o1 - o2).max() == 0.0
+    db = jax.grad(lambda bb: jnp.sum(flash_attention(
+        q, k, v, bias=bb, bias_grad=False, block_q=16, block_k=16) ** 2)
+    )(bias)
+    assert jnp.abs(db).max() == 0.0
+    # dq still flows
+    dq = jax.grad(lambda qq: jnp.sum(flash_attention(
+        qq, k, v, bias=bias, bias_grad=False, block_q=16, block_k=16) ** 2)
+    )(q)
+    assert jnp.abs(dq).max() > 0.0
