@@ -9,9 +9,11 @@ Philox attention dropout, and separate-vs-packed QKV parameters.
 
 TPU-native: the projections are XLA GEMMs (epilogue fusion is the
 cublasLt analogue); the attention core dispatches to the Pallas flash
-kernel (in-kernel hash dropout — the Philox analogue) when the mask is a
-key-padding/causal one, and to an explicit fused softmax path for additive
-masks; ``include_norm_add`` uses the fused LayerNorm with the residual
+kernel (in-kernel hash dropout — the Philox analogue) for key-padding /
+causal masks AND for additive masks (via the kernel's additive-bias input,
+``bias_grad=False``); only mask layouts the kernel cannot tile fall back
+to an explicit fused-softmax path; ``include_norm_add`` uses the fused
+LayerNorm with the residual
 dropout-add epilogue. Layout is the reference's Time x Batch x Channel
 (``[s, b, h]``).
 
@@ -82,16 +84,32 @@ def _attend(q, k, v, num_heads, scaling, key_padding_mask, attn_mask,
     kh = _split_heads(k, num_heads)
     vh = _split_heads(v, num_heads)
 
+    b, n = qh.shape[0], qh.shape[1]
     s_q, s_k, d = qh.shape[2], kh.shape[2], qh.shape[3]
-    flash_ok = (
-        not mask_additive
-        and attn_mask is None
-        and flash_attention_available(
-            s_q, s_k, d, interpret=jax.default_backend() != "tpu")
-    )
+    kernel_ok = flash_attention_available(
+        s_q, s_k, d, interpret=jax.default_backend() != "tpu")
+    # additive masks ride the flash kernel's additive-bias input (constant,
+    # so bias_grad=False skips the O(s^2) dbias in backward); only mask
+    # layouts outside [b,1,1,s_k] / [b|1,n|1,s_q,s_k] fall back to the
+    # materialised-score path
+    flash_bias = None
+    flash_ok = kernel_ok and not mask_additive and attn_mask is None
+    if kernel_ok and not flash_ok:
+        if (mask_additive and attn_mask is None
+                and key_padding_mask is not None
+                and key_padding_mask.ndim == 2):
+            flash_bias = key_padding_mask.astype(jnp.float32)[:, None, None, :]
+            flash_ok = True
+        elif (attn_mask is not None and attn_mask.ndim == 4
+                and attn_mask.shape[0] in (1, b)
+                and attn_mask.shape[1] in (1, n)
+                and attn_mask.shape[2] in (1, s_q)
+                and attn_mask.shape[3] == s_k):
+            flash_bias = attn_mask.astype(jnp.float32)
+            flash_ok = True
     if flash_ok:
         kv_mask = None
-        if key_padding_mask is not None:
+        if key_padding_mask is not None and flash_bias is None:
             kv_mask = key_padding_mask == 0  # flash: True = attend
         seed = None
         if dropout_prob > 0.0:
@@ -100,8 +118,8 @@ def _attend(q, k, v, num_heads, scaling, key_padding_mask, attn_mask,
             seed = jax.random.randint(
                 dropout_key, (), -(2 ** 31), 2 ** 31 - 1, jnp.int32)
         ctx = flash_attention(
-            qh, kh, vh, kv_mask=kv_mask, scale=scaling,
-            dropout_p=dropout_prob, dropout_seed=seed,
+            qh, kh, vh, kv_mask=kv_mask, bias=flash_bias, bias_grad=False,
+            scale=scaling, dropout_p=dropout_prob, dropout_seed=seed,
         )
     else:
         scores = jnp.einsum(

@@ -59,6 +59,17 @@ def can_use_fused_attention(
     return flash_attention_available(n, n, d, interpret=interpret)
 
 
+def _drop5(x, what):
+    """Strip a validated leading 1 dim from a 5-dim mask/bias operand."""
+    if x.ndim == 5:
+        if x.shape[0] != 1:
+            raise ValueError(
+                f"5-dim {what} must have a leading 1 dim, got {x.shape}"
+            )
+        return x[0]
+    return x
+
+
 def _to_bnsd(x):
     """[*, h, n, d] with 4 or 5 dims -> ([b, h, n, d], had_5dim)."""
     if x.ndim == 5:
@@ -97,15 +108,6 @@ def attention_core(
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
 
-    def _drop5(x, what):
-        if x.ndim == 5:
-            if x.shape[0] != 1:
-                raise ValueError(
-                    f"5-dim {what} must have a leading 1 dim, got {x.shape}"
-                )
-            return x[0]
-        return x
-
     kv_mask = None
     mask_bias = None
     if mask is not None:
@@ -123,17 +125,14 @@ def attention_core(
         bias = _drop5(bias, "bias")
         while bias.ndim < 4:
             bias = bias[None]
-        # the kernel broadcasts batch/head dims itself; q/k dims must be
-        # materialised (a [.., 1, k] per-key bias is legal here)
-        bias = jnp.broadcast_to(
-            bias, bias.shape[:2] + (s_q, s_k)
-        )
+        # the kernel itself broadcasts batch/head dims and a size-1 q dim;
+        # only a size-1 KEY dim needs materialising
+        if bias.shape[-1] != s_k:
+            bias = jnp.broadcast_to(bias, bias.shape[:3] + (s_k,))
         if add_bias is None:
             add_bias = bias
         else:
-            add_bias = add_bias + jnp.broadcast_to(
-                bias, (b, h, s_q, s_k)
-            ).astype(jnp.float32)
+            add_bias = add_bias + bias.astype(jnp.float32)
 
     o = flash_attention(
         q, k, v, bias=add_bias, kv_mask=kv_mask,
@@ -164,20 +163,10 @@ def attention_reference(
         "bhqd,bhkd->bhqk", q * scale, k, preferred_element_type=jnp.float32
     )
     if mask is not None:
-        if mask.ndim == 5:
-            if mask.shape[0] != 1:
-                raise ValueError(
-                    f"5-dim mask must have a leading 1 dim, got {mask.shape}"
-                )
-            mask = mask[0]
+        mask = _drop5(mask, "mask")
         a = a + (mask.astype(jnp.float32) - 1.0) * inf
     if bias is not None:
-        if bias.ndim == 5:
-            if bias.shape[0] != 1:
-                raise ValueError(
-                    f"5-dim bias must have a leading 1 dim, got {bias.shape}"
-                )
-            bias = bias[0]
+        bias = _drop5(bias, "bias")
         a = a + bias.astype(jnp.float32)
     a = jax.nn.softmax(a, axis=-1)
     o = jnp.einsum(

@@ -289,23 +289,27 @@ def _seg_args(segments, s):
 
 def _bias_args(bias, bq, bk, kmajor):
     """(array, spec, have) for the optional additive-bias input
-    ``[b|1, n|1, s_q, s_k]``; broadcast batch/head dims pin their block
-    index to 0. ``kmajor`` selects the (ik, iq) grid order of the dkv
-    backward kernel."""
+    ``[b|1, n|1, s_q|1, s_k]``; broadcast batch/head/row dims pin their
+    block index to 0 (a row-broadcast bias — e.g. an additive key-padding
+    mask — streams [1, bk] tiles and broadcasts in-kernel). ``kmajor``
+    selects the (ik, iq) grid order of the dkv backward kernel."""
     have = bias is not None
     if not have:
         arr = jnp.zeros((1, 1, 8, 128), jnp.float32)
         return arr, pl.BlockSpec(
             (1, 1, 8, 128), lambda ib, ih, i2, i3: (0, 0, 0, 0)
         ), False
-    bb, bn = bias.shape[0], bias.shape[1]
+    bb, bn, brow = bias.shape[0], bias.shape[1], bias.shape[2]
+    row_block = bq if brow > 1 else 1
     if kmajor:
         im = lambda ib, ih, ik, iq: (
-            ib if bb > 1 else 0, ih if bn > 1 else 0, iq, ik)
+            ib if bb > 1 else 0, ih if bn > 1 else 0,
+            iq if brow > 1 else 0, ik)
     else:
         im = lambda ib, ih, iq, ik: (
-            ib if bb > 1 else 0, ih if bn > 1 else 0, iq, ik)
-    return bias, pl.BlockSpec((1, 1, bq, bk), im), True
+            ib if bb > 1 else 0, ih if bn > 1 else 0,
+            iq if brow > 1 else 0, ik)
+    return bias, pl.BlockSpec((1, 1, row_block, bk), im), True
 
 
 def _fwd(
@@ -764,6 +768,8 @@ def _flash_bwd(scale, causal, dropout_p, block_q, block_k, interpret,
                 dbias = dbias.sum(axis=0, keepdims=True)
             if bias.shape[1] == 1:
                 dbias = dbias.sum(axis=1, keepdims=True)
+            if bias.shape[2] == 1:
+                dbias = dbias.sum(axis=2, keepdims=True)
             dbias = dbias.astype(bias.dtype)
     return dq, dk, dv, dbias, None, None, None
 
@@ -827,10 +833,11 @@ def flash_attention(
         s_k = k.shape[2]
         if (bias.ndim != 4 or bias.shape[0] not in (1, b)
                 or bias.shape[1] not in (1, n)
-                or bias.shape[2:] != (s_q, s_k)):
+                or bias.shape[2] not in (1, s_q)
+                or bias.shape[3] != s_k):
             raise ValueError(
-                f"bias shape {bias.shape} must be [b|1, n|1, s_q, s_k] = "
-                f"[{b}|1, {n}|1, {s_q}, {s_k}]"
+                f"bias shape {bias.shape} must be [b|1, n|1, s_q|1, s_k] = "
+                f"[{b}|1, {n}|1, {s_q}|1, {s_k}]"
             )
         # a [1024, 1024] fp32 score tile + bias tile + dbias tile would
         # crowd VMEM; cap blocks at 512 when a bias is present

@@ -368,7 +368,8 @@ def test_gpt_flash_with_attention_dropout():
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("bias_shape", [(2, 2, 64, 64), (1, 2, 64, 64),
-                                        (2, 1, 64, 64), (1, 1, 64, 64)])
+                                        (2, 1, 64, 64), (1, 1, 64, 64),
+                                        (2, 1, 1, 64), (1, 1, 1, 64)])
 def test_flash_bias_forward_matches_reference(causal, bias_shape):
     key = jax.random.PRNGKey(11)
     q, k, v = _qkv(key)
@@ -379,7 +380,8 @@ def test_flash_bias_forward_matches_reference(causal, bias_shape):
     assert jnp.abs(o - ref).max() < 2e-5
 
 
-@pytest.mark.parametrize("bias_shape", [(2, 2, 64, 64), (1, 2, 64, 64)])
+@pytest.mark.parametrize("bias_shape", [(2, 2, 64, 64), (1, 2, 64, 64),
+                                        (2, 1, 1, 64)])
 def test_flash_bias_grads_match_reference(bias_shape):
     """dq/dk/dv/dbias vs the materialised reference — incl. the broadcast
     reduction of dbias over a collapsed batch dim."""
