@@ -51,7 +51,11 @@ def mask_softmax_dropout(
     s = scores.astype(jnp.float32)
     if mask is not None:
         if mask_additive:
-            m = mask.astype(jnp.float32)
+            # masks carry NO gradient (the reference autograd functions
+            # return None for the mask input) — stop_gradient keeps this
+            # path consistent with the flash dispatch, whose bias_grad=
+            # False skips the mask cotangent in-kernel
+            m = jax.lax.stop_gradient(mask).astype(jnp.float32)
             if m.ndim == 2:  # additive key-padding [b, sk] -> [b, 1, 1, sk]
                 m = m[:, None, None, :]
             s = s + m
@@ -258,7 +262,11 @@ class SelfMultiheadAttn:
         """query [s, b, h]; self-attention ignores key/value (parity args).
         ``key_padding_mask`` [b, s]: 1 = masked out, or additive values
         when ``mask_additive``; ``attn_mask`` additive
-        [b?, n?, sq, sk]-broadcastable."""
+        [b?, n?, sq, sk]-broadcastable. Masks are non-differentiable on
+        every path (reference parity: the autograd functions return None
+        for mask inputs) — for a LEARNED additive bias call
+        ``apex_tpu.ops.flash_attention`` with ``bias=..., bias_grad=True``
+        instead."""
         del key, value, need_weights
         self._check_masks(key_padding_mask, attn_mask)
         h = self.embed_dim

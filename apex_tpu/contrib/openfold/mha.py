@@ -118,7 +118,13 @@ def attention_core(
         if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
             kv_mask = jnp.broadcast_to(mask[:, 0, 0, :], (b, s_k))
         else:
-            m = jnp.broadcast_to(mask, (b, h, s_q, s_k)).astype(jnp.float32)
+            m = mask.astype(jnp.float32)
+            while m.ndim < 4:
+                m = m[None]
+            # only the key dim needs materialising; the kernel broadcasts
+            # size-1 batch/head/q dims itself
+            if m.shape[-1] != s_k:
+                m = jnp.broadcast_to(m, m.shape[:3] + (s_k,))
             mask_bias = (m - 1.0) * inf
     add_bias = mask_bias
     if bias is not None:

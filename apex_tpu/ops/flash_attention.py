@@ -25,7 +25,7 @@ tokens only attend within their own sequence.
 
 Supports: causal masking (block-skipped: tiles strictly above the diagonal
 are neither loaded nor computed), a key-padding mask ``[b, s_k]`` (True =
-attend), an **additive logit bias** ``[b|1, n|1, s_q, s_k]`` streamed in
+attend), an **additive logit bias** ``[b|1, n|1, s_q|1, s_k]`` streamed in
 ``[block_q, block_k]`` tiles (never fully VMEM-resident) with gradients —
 the AlphaFold pair bias / ALiBi / T5 relative-position case, and the
 capability behind the reference's openfold MHA
@@ -83,6 +83,19 @@ def _lane_block(s: int, blk: int) -> int:
     if cands:
         return min(cands, key=lambda c: abs(c - blk))
     return s
+
+
+def _sds(shape, dtype, *inputs):
+    """ShapeDtypeStruct for a pallas_call output, carrying the union of
+    the inputs' shard_map varying-manual-axes: under ``check_vma=True``
+    (e.g. ring attention calling these kernels inside shard_map) pallas
+    requires outputs to declare their vma explicitly."""
+    vma = set()
+    for x in inputs:
+        vma |= set(getattr(getattr(x, "aval", None), "vma", None) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def flash_attention_available(
@@ -366,8 +379,10 @@ def _fwd(
     )
     grid = (b, n, n_q, n_k)
     out_shape = [
-        jax.ShapeDtypeStruct((b, n, s_q, d), q.dtype),
-        jax.ShapeDtypeStruct((b, n, s_q, 1), jnp.float32),
+        _sds((b, n, s_q, d), q.dtype, q, k, v, bias_arg, mask_arg,
+             segq_arg, segk_arg, seed_arg),
+        _sds((b, n, s_q, 1), jnp.float32, q, k, v, bias_arg, mask_arg,
+             segq_arg, segk_arg, seed_arg),
     ]
     scratch = [
         pltpu.VMEM((bq, 128), jnp.float32),
@@ -636,8 +651,9 @@ def _bwd(
     bias_q, bias_spec_q, _ = _bias_args(bias, bq, bk, False)
     bias_k, bias_spec_k, _ = _bias_args(bias, bq, bk, True)
 
+    _ins = (q, k, v, do, bias_q, mask_arg, segq_arg, segk_arg, seed_arg)
     dq_out_specs = [q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0))]
-    dq_out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    dq_out_shape = [_sds(q.shape, q.dtype, *_ins)]
     if emit_dbias:
         # dbias comes out FULL [b, n, s_q, s_k] (each grid step owns one
         # (iq, ik) tile); broadcast input dims are reduced by the caller.
@@ -646,8 +662,7 @@ def _bwd(
         # pays for an expanded bias in the reference openfold kernels.
         dq_out_specs.append(pl.BlockSpec(
             (1, 1, bq, bk), lambda ib, ih, iq, ik: (ib, ih, iq, ik)))
-        dq_out_shape.append(
-            jax.ShapeDtypeStruct((b, n, s_q, s_k), jnp.float32))
+        dq_out_shape.append(_sds((b, n, s_q, s_k), jnp.float32, *_ins))
 
     dq_res = pl.pallas_call(
         functools.partial(
@@ -708,8 +723,8 @@ def _bwd(
             k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _sds(k.shape, k.dtype, *_ins),
+            _sds(v.shape, v.dtype, *_ins),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -799,7 +814,7 @@ def flash_attention(
     *,
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,  # [b, s_k]; True/nonzero = attend
-    bias: Optional[jax.Array] = None,  # [b|1, n|1, s_q, s_k] added to logits
+    bias: Optional[jax.Array] = None,  # [b|1, n|1, s_q|1, s_k] logit bias
     bias_grad: bool = True,
     scale: Optional[float] = None,
     dropout_p: float = 0.0,

@@ -1,0 +1,319 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+Long-context sequence parallelism that shards the sequence dim *inside*
+attention — each device holds ``s/cp`` tokens end-to-end, so max sequence
+length scales linearly with the ``cp`` axis. This goes beyond the
+reference, whose only long-context mechanism is Megatron SP
+(``apex/transformer/tensor_parallel/mappings.py:213-268``: activations are
+sequence-sharded *between* layers but every rank still materialises the
+full sequence inside attention) plus activation checkpointing / CPU
+offload (``tensor_parallel/random.py:237``,
+``testing/standalone_gpt.py:59-61``). SURVEY §2.4 notes ring/Ulysses CP is
+"out of reference scope (ICI makes ring-CP cheap if we ever extend)" —
+this module is that extension, and it is TPU-first by construction:
+
+- **ring attention** (`ring_attention`): K/V shards rotate around the
+  ``cp`` ring via ``jax.lax.ppermute`` (neighbor hops ride ICI); each step
+  runs the Pallas flash kernel on (local Q x visiting KV chunk) and merges
+  partial results with the online-softmax log-sum-exp rule, so per-device
+  attention memory stays O(s/cp). The backward is the flash-attention-2
+  chunked scheme: global ``lse``/``delta`` drive per-chunk recomputation,
+  dQ accumulates locally, dK/dV accumulate in a carry that rotates *with*
+  its KV chunk and is home after ``cp`` hops.
+- **Ulysses attention** (`ulysses_attention`): two ``jax.lax.all_to_all``
+  collectives swap the sharded dim (sequence <-> heads) so attention runs
+  on full sequences with ``n/cp`` local heads; plain differentiable code —
+  the a2a transposes to the reverse a2a under shard_map vma tracking.
+
+Both run inside ``shard_map`` (``check_vma=True``) binding the caller's
+context axis; they compose with the repo's tp/pp/dp axes (the attention
+operands are already head-sharded under TP — ring CP multiplies on top).
+
+Causal ring scheduling: step 0 is the local causal block; step t>0 visits
+chunk ``(i-t) mod cp``, which is entirely in the past for ranks ``i >= t``
+and entirely in the future (fully masked, contributes nothing) otherwise.
+Devices therefore idle-compute masked chunks for ~half the steps — the
+plain-ordering bubble; a zigzag layout would balance it and can be layered
+on without changing this core.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import _NEG_INF
+from apex_tpu.ops.flash_attention import _bwd as _pallas_bwd_chunk
+from apex_tpu.ops.flash_attention import _fwd as _pallas_fwd_chunk
+from apex_tpu.ops.flash_attention import mha_reference
+
+
+def _scores(q, k, kv_mask, causal, scale):
+    s = jnp.einsum(
+        "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(ki > qi, _NEG_INF, s)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] != 0, s, _NEG_INF)
+    return s
+
+
+def _chunk_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k,
+               interpret):
+    """(o, lse) for one KV chunk. TPU: the Pallas flash kernel. Interpret
+    (CPU tests): dense XLA with the kernel's exact conventions — the
+    Pallas interpreter cannot run under shard_map's check_vma (its
+    internal dynamic_slice mixes varying/replicated operands)."""
+    if not interpret:
+        return _pallas_fwd_chunk(
+            q, k, v, None, kv_mask, None, None, None, scale, causal, 0.0,
+            block_q, block_k, False,
+        )
+    s = _scores(q, k, kv_mask, causal, scale)
+    m = jnp.max(s, axis=-1)
+    alive = m > _NEG_INF / 2
+    m_safe = jnp.where(alive, m, 0.0)
+    l = jnp.sum(jnp.exp(s - m_safe[..., None]), axis=-1, where=s > _NEG_INF / 2,
+                initial=0.0)
+    lse = jnp.where(alive, m_safe + jnp.log(jnp.maximum(l, 1e-37)), _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    o = jnp.einsum("bnqk,bnkd->bnqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+def _chunk_bwd(q, k, v, kv_mask, o, lse, do, scale, causal, block_q,
+               block_k, interpret):
+    """(dq, dk, dv) of one chunk given GLOBAL (o, lse, do) — the
+    flash-attention-2 chunked backward."""
+    if not interpret:
+        dq, dk, dv, _ = _pallas_bwd_chunk(
+            q, k, v, None, kv_mask, None, None, None, o, lse, do, scale,
+            causal, 0.0, block_q, block_k, False, False,
+        )
+        return dq, dk, dv
+    s = _scores(q, k, kv_mask, causal, scale)
+    p = jnp.exp(s - lse[..., None])
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [b, n, s_q]
+    dv = jnp.einsum("bnqk,bnqd->bnkd", p, dof)
+    dp = jnp.einsum("bnqd,bnkd->bnqk", dof, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bnqk,bnkd->bnqd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bnqk,bnqd->bnkd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _shift(x, axis_name: str):
+    """Rotate a pytree one hop up the ring (rank i -> i+1 mod cp)."""
+    cp = jax.lax.axis_size(axis_name)
+    perm = [(s, (s + 1) % cp) for s in range(cp)]
+    return jax.tree_util.tree_map(
+        lambda t: jax.lax.ppermute(t, axis_name, perm), x
+    )
+
+
+def _chunk_mask(b: int, s_k: int, alive) -> jax.Array:
+    """[b, s_k] int8 kv-mask that is all-ones (attend) or all-zeros
+    (chunk fully in the causal future) per device."""
+    return jnp.broadcast_to(
+        alive.astype(jnp.int8), (b, s_k)
+    )
+
+
+def _merge(o_acc, lse_acc, o_j, lse_j):
+    """Online-softmax merge of two normalised partials via their lse."""
+    lse_new = jnp.logaddexp(lse_acc, lse_j)
+    # fully-masked-so-far rows: keep the 0-output convention (both weights
+    # underflow to 0 via the -1e30 lse sentinel)
+    w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+    w_j = jnp.exp(lse_j - lse_new)[..., None]
+    return o_acc * w_acc + o_j.astype(o_acc.dtype) * w_j, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+    o, _ = _ring_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return o
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
+                   interpret):
+    b, n, s_loc, d = q.shape
+    cp = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+
+    o_acc = jnp.zeros((b, n, s_loc, d), jnp.float32)
+    lse_acc = jnp.full((b, n, s_loc), -1e30, jnp.float32)
+    k_t, v_t = k, v
+    for t in range(cp):  # cp is static (mesh axis size)
+        if causal:
+            kv_mask = None if t == 0 else _chunk_mask(b, s_loc, i >= t)
+            step_causal = t == 0
+        else:
+            kv_mask = None
+            step_causal = False
+        o_j, lse_j = _chunk_fwd(
+            q, k_t, v_t, kv_mask, scale, step_causal, block_q, block_k,
+            interpret,
+        )
+        o_acc, lse_acc = _merge(o_acc, lse_acc, o_j, lse_j)
+        if t != cp - 1:
+            k_t, v_t = _shift((k_t, v_t), axis_name)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+              interpret):
+    o, lse = _ring_fwd_impl(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, block_q, block_k, interpret,
+              res, do):
+    q, k, v, o, lse = res
+    b, n, s_loc, d = q.shape
+    cp = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    k_t, v_t = k, v
+    dk_t = jnp.zeros(k.shape, jnp.float32)
+    dv_t = jnp.zeros(v.shape, jnp.float32)
+    for t in range(cp):
+        if causal:
+            kv_mask = None if t == 0 else _chunk_mask(b, s_loc, i >= t)
+            step_causal = t == 0
+        else:
+            kv_mask = None
+            step_causal = False
+        # global (o, lse, do) -> the chunk's share of the exact backward:
+        # p = exp(s_chunk - lse_global), delta = rowsum(do * o_global)
+        dq_j, dk_j, dv_j = _chunk_bwd(
+            q, k_t, v_t, kv_mask, o, lse, do, scale, step_causal, block_q,
+            block_k, interpret,
+        )
+        dq = dq + dq_j.astype(jnp.float32)
+        dk_t = dk_t + dk_j.astype(jnp.float32)
+        dv_t = dv_t + dv_j.astype(jnp.float32)
+        # the dK/dV accumulators travel WITH their kv chunk; after the
+        # cp-th hop they are back on the chunk's home rank
+        k_t, v_t, dk_t, dv_t = _shift((k_t, v_t, dk_t, dv_t), axis_name)
+    return dq.astype(q.dtype), dk_t.astype(k.dtype), dv_t.astype(v.dtype)
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
+
+
+@jax.named_scope("apex_tpu.ring_attention")
+def ring_attention(
+    q: jax.Array,  # [b, n, s_local, d] — this rank's sequence shard
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention over the ``axis_name`` mesh axis (call inside
+    ``shard_map``). Sequence shards are laid out contiguously by rank:
+    global position = ``rank * s_local + local position`` (causal masking
+    uses exactly this order). Returns this rank's output shard.
+
+    Dropout is not supported on the CP path (the per-chunk kernels would
+    need globally-consistent counters); apply dropout outside attention
+    or use Ulysses, which sees full sequences locally.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True
+    return _ring(
+        q, k, v, axis_name, bool(causal), float(scale), int(block_q),
+        int(block_k), bool(interpret),
+    )
+
+
+@jax.named_scope("apex_tpu.ulysses_attention")
+def ulysses_attention(
+    q: jax.Array,  # [b, n, s_local, d]
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style all-to-all attention (inside ``shard_map``):
+    a2a swaps the sharded dim sequence->heads, the flash kernel runs on
+    the full sequence with ``n/cp`` local heads, and the reverse a2a
+    restores sequence sharding. Requires ``n % cp == 0``. Cheaper than
+    ring when the head count allows it (two a2a hops vs cp-1 ppermutes);
+    ring has no head-count constraint.
+    """
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True
+    cp = jax.lax.axis_size(axis_name)
+    n = q.shape[1]
+    if n % cp != 0:
+        raise ValueError(
+            f"ulysses needs heads ({n}) divisible by axis {axis_name!r} "
+            f"size ({cp}); use ring_attention otherwise"
+        )
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=1,
+        concat_axis=2, tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)  # [b, n/cp, s_full, d]
+    seed = None
+    if dropout_p > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        # decorrelate the in-kernel hash across head shards (local head
+        # indices repeat on every rank)
+        seed = (
+            jnp.asarray(dropout_seed, jnp.int32)
+            + jax.lax.axis_index(axis_name).astype(jnp.int32)
+            * jnp.int32(0x632BE5AB)
+        )
+    if interpret:
+        # the Pallas interpreter cannot run under check_vma shard_map; the
+        # dense reference shares the kernels' exact math (incl. the hash
+        # dropout mask) for CPU-mesh tests
+        o = mha_reference(
+            qh, kh, vh, causal=causal, scale=scale, dropout_p=dropout_p,
+            dropout_seed=seed,
+        )
+    else:
+        o = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale, dropout_p=dropout_p,
+            dropout_seed=seed,
+        )
+    return jax.lax.all_to_all(
+        o, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def ring_attention_reference(q, k, v, *, causal=False, scale=None):
+    """Dense single-device reference on the FULL sequence (tests): the
+    sharded result gathered over the cp axis must equal this."""
+    return mha_reference(q, k, v, causal=causal, scale=scale)

@@ -199,3 +199,28 @@ def test_self_attn_additive_2d_key_padding_mask():
     kpm = jnp.zeros((B, S), jnp.int32).at[:, -4:].set(1)
     ref, _ = m2(params, x, key_padding_mask=kpm, is_training=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_additive_mask_carries_no_gradient_on_both_paths():
+    """Reference parity: autograd functions return None for mask inputs.
+    The flash dispatch (bias_grad=False) and the fallback softmax path
+    (stop_gradient) must agree: zero cotangent for additive masks."""
+    import os
+    mod = SelfMultiheadAttn(embed_dim=32, num_heads=2, mask_additive=True)
+    params = mod.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 32))
+    kpm = jnp.zeros((2, 16))
+
+    def loss(m):
+        out = mod(params, x, key_padding_mask=m, is_training=False)
+        out = out[0] if isinstance(out, tuple) else out
+        return jnp.sum(out ** 2)
+
+    g_flash = jax.grad(loss)(kpm)
+    assert jnp.abs(g_flash).max() == 0.0
+    os.environ["APEX_TPU_DISABLE_FLASH"] = "1"
+    try:
+        g_fallback = jax.grad(loss)(kpm)
+    finally:
+        del os.environ["APEX_TPU_DISABLE_FLASH"]
+    assert jnp.abs(g_fallback).max() == 0.0
