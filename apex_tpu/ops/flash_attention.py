@@ -941,15 +941,11 @@ def flash_attention_varlen(
     return o[0].transpose(1, 0, 2)  # [total, n, d]
 
 
-def mha_reference(
-    q, k, v, *, causal=False, kv_mask=None, bias=None, scale=None,
-    dropout_p=0.0, dropout_seed=None,
-) -> jax.Array:
-    """Materialised-score reference (for tests): same math, O(s^2) — incl.
-    the kernels' exact hash-dropout mask and the zeros-for-fully-masked-rows
-    convention."""
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
+def masked_scores(q, k, kv_mask, causal, scale, bias=None) -> jax.Array:
+    """Dense fp32 ``[b, n, s_q, s_k]`` logits with the kernels' exact
+    masking conventions (scale -> +bias -> causal/kv_mask as ``_NEG_INF``
+    fills). Shared by :func:`mha_reference` and the context-parallel
+    interpret path so the conventions cannot drift."""
     s = jnp.einsum(
         "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -962,6 +958,19 @@ def mha_reference(
         s = jnp.where(ki > qi, _NEG_INF, s)
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :] != 0, s, _NEG_INF)
+    return s
+
+
+def mha_reference(
+    q, k, v, *, causal=False, kv_mask=None, bias=None, scale=None,
+    dropout_p=0.0, dropout_seed=None,
+) -> jax.Array:
+    """Materialised-score reference (for tests): same math, O(s^2) — incl.
+    the kernels' exact hash-dropout mask and the zeros-for-fully-masked-rows
+    convention."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = masked_scores(q, k, kv_mask, causal, scale, bias)
     p = jax.nn.softmax(s, axis=-1)
     # zeros-for-fully-masked-rows (flash kernel convention): a row whose
     # keys are all masked outputs 0, not the uniform average softmax yields

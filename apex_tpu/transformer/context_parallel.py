@@ -44,24 +44,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.flash_attention import _NEG_INF
+from apex_tpu.ops.flash_attention import _NEG_INF, masked_scores
 from apex_tpu.ops.flash_attention import _bwd as _pallas_bwd_chunk
 from apex_tpu.ops.flash_attention import _fwd as _pallas_fwd_chunk
 from apex_tpu.ops.flash_attention import mha_reference
-
-
-def _scores(q, k, kv_mask, causal, scale):
-    s = jnp.einsum(
-        "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        sq, sk = s.shape[-2:]
-        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(ki > qi, _NEG_INF, s)
-    if kv_mask is not None:
-        s = jnp.where(kv_mask[:, None, None, :] != 0, s, _NEG_INF)
-    return s
 
 
 def _chunk_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k,
@@ -75,7 +61,7 @@ def _chunk_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k,
             q, k, v, None, kv_mask, None, None, None, scale, causal, 0.0,
             block_q, block_k, False,
         )
-    s = _scores(q, k, kv_mask, causal, scale)
+    s = masked_scores(q, k, kv_mask, causal, scale)
     m = jnp.max(s, axis=-1)
     alive = m > _NEG_INF / 2
     m_safe = jnp.where(alive, m, 0.0)
@@ -98,7 +84,7 @@ def _chunk_bwd(q, k, v, kv_mask, o, lse, do, scale, causal, block_q,
             causal, 0.0, block_q, block_k, False, False,
         )
         return dq, dk, dv
-    s = _scores(q, k, kv_mask, causal, scale)
+    s = masked_scores(q, k, kv_mask, causal, scale)
     p = jnp.exp(s - lse[..., None])
     p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
     dof = do.astype(jnp.float32)
@@ -207,9 +193,13 @@ def _ring_bwd(axis_name, causal, scale, block_q, block_k, interpret,
         dq = dq + dq_j.astype(jnp.float32)
         dk_t = dk_t + dk_j.astype(jnp.float32)
         dv_t = dv_t + dv_j.astype(jnp.float32)
-        # the dK/dV accumulators travel WITH their kv chunk; after the
-        # cp-th hop they are back on the chunk's home rank
-        k_t, v_t, dk_t, dv_t = _shift((k_t, v_t, dk_t, dv_t), axis_name)
+        # the dK/dV accumulators travel WITH their kv chunk and need the
+        # final hop to reach the chunk's home rank; k_t/v_t are dead after
+        # the last step, so skip their hop (same guard as the forward)
+        if t != cp - 1:
+            k_t, v_t, dk_t, dv_t = _shift((k_t, v_t, dk_t, dv_t), axis_name)
+        else:
+            dk_t, dv_t = _shift((dk_t, dv_t), axis_name)
     return dq.astype(q.dtype), dk_t.astype(k.dtype), dv_t.astype(v.dtype)
 
 

@@ -201,11 +201,11 @@ def test_self_attn_additive_2d_key_padding_mask():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_additive_mask_carries_no_gradient_on_both_paths():
+def test_additive_mask_carries_no_gradient_on_both_paths(monkeypatch):
     """Reference parity: autograd functions return None for mask inputs.
     The flash dispatch (bias_grad=False) and the fallback softmax path
     (stop_gradient) must agree: zero cotangent for additive masks."""
-    import os
+    monkeypatch.delenv("APEX_TPU_DISABLE_FLASH", raising=False)
     mod = SelfMultiheadAttn(embed_dim=32, num_heads=2, mask_additive=True)
     params = mod.init(jax.random.PRNGKey(0))
     x = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 32))
@@ -218,9 +218,6 @@ def test_additive_mask_carries_no_gradient_on_both_paths():
 
     g_flash = jax.grad(loss)(kpm)
     assert jnp.abs(g_flash).max() == 0.0
-    os.environ["APEX_TPU_DISABLE_FLASH"] = "1"
-    try:
-        g_fallback = jax.grad(loss)(kpm)
-    finally:
-        del os.environ["APEX_TPU_DISABLE_FLASH"]
+    monkeypatch.setenv("APEX_TPU_DISABLE_FLASH", "1")
+    g_fallback = jax.grad(loss)(kpm)
     assert jnp.abs(g_fallback).max() == 0.0
