@@ -14,14 +14,21 @@ header — exactly the reference's format (``gds.cpp`` writes
 ``tensor.nbytes`` raw). ``load_data`` takes the template array (shape +
 dtype, like the reference's preallocated tensor) and returns the loaded
 device array (functional: JAX arrays are immutable).
+
+IO runs through the native multithreaded engine
+(``apex_tpu/csrc/hostio.cpp`` — the gds.cpp counterpart) when the
+toolchain can build it, with a transparent pure-Python fallback.
 """
 from __future__ import annotations
 
+import os
 from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from apex_tpu.ops.hostio import read_arrays, write_arrays
 
 
 class _GDSFile:
@@ -30,26 +37,44 @@ class _GDSFile:
             raise ValueError(f"mode must be r, w or rw, got {mode!r}")
         self._filename = filename
         self._mode = mode
-        self._handle = open(filename, {"r": "rb", "w": "wb", "rw": "r+b"}[mode])
+        self._pos = 0  # stream position, advanced per save/load
+        flags = {
+            "r": os.O_RDONLY,
+            "w": os.O_RDWR | os.O_CREAT | os.O_TRUNC,
+            "rw": os.O_RDWR,  # must exist (reference parity)
+        }[mode]
+        # one descriptor for the GDSFile's lifetime — save/load issue
+        # pread/pwrite against it instead of reopening per tensor
+        self._fd: int | None = os.open(filename, flags, 0o644)
+
+    def _live_fd(self) -> int:
+        if self._fd is None:
+            raise ValueError("I/O operation on closed GDSFile")
+        return self._fd
 
     def save_data(self, tensor: jax.Array) -> None:
         if "w" not in self._mode:
             raise RuntimeError(f"file opened with mode {self._mode!r}")
-        self._handle.write(np.ascontiguousarray(jax.device_get(tensor)).tobytes())
+        fd = self._live_fd()
+        host = np.ascontiguousarray(jax.device_get(tensor))
+        write_arrays(fd, [host], offsets=[self._pos])
+        self._pos += host.nbytes
 
     def load_data(self, tensor: jax.Array) -> jax.Array:
         """Read ``tensor.nbytes`` bytes into an array shaped/typed like
         ``tensor``; returns the new device array."""
         if "r" not in self._mode:
             raise RuntimeError(f"file opened with mode {self._mode!r}")
+        fd = self._live_fd()
         dt = jnp.dtype(tensor.dtype)  # numpy dtype (incl. ml_dtypes bf16)
-        count = int(np.prod(tensor.shape))
-        buf = self._handle.read(count * dt.itemsize)
-        if len(buf) != count * dt.itemsize:
+        need = int(np.prod(tensor.shape)) * dt.itemsize
+        if self._pos + need > os.fstat(fd).st_size:
             raise EOFError(
-                f"expected {count * dt.itemsize} bytes, got {len(buf)}"
+                f"expected {need} bytes at offset {self._pos} of "
+                f"{self._filename}"
             )
-        arr = np.frombuffer(buf, dtype=dt).reshape(tensor.shape)
+        (arr,) = read_arrays(fd, [(tuple(tensor.shape), dt)], [self._pos])
+        self._pos += need
         return jnp.asarray(arr)
 
     # raw-bytes aliases of the reference's no-GDS fallback entry points
@@ -57,7 +82,9 @@ class _GDSFile:
     save_data_no_gds = save_data
 
     def close(self) -> None:
-        self._handle.close()
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
 
 
 @contextmanager

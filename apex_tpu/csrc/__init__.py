@@ -1,0 +1,106 @@
+"""apex_tpu.csrc — native (C++) host-runtime components.
+
+The reference builds ~60k LoC of CUDA under ``csrc/``/``contrib/csrc/``;
+on TPU the device compute path is Pallas/XLA, but the HOST-side runtime
+pieces the reference implements natively keep a native implementation
+here: :mod:`hostio` (``hostio.cpp``) covers ``gds.cpp`` (direct
+tensor<->file IO) and ``flatten_unflatten.cpp`` (bucket packing) with
+multithreaded pread/pwrite/memcpy.
+
+Compiled on first use with the system ``g++`` (no pybind11 — plain C ABI
+loaded via ctypes), cached next to the source keyed by a source hash.
+``load_hostio()`` returns the configured ctypes library, or ``None`` when
+no toolchain is available (consumers fall back to Python IO).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "hostio.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build(src: str, out: str) -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        src, "-o", out,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "hostio native build failed (falling back to Python IO):\n%s",
+            proc.stderr[-2000:],
+        )
+        return False
+    return True
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    p64 = ctypes.POINTER(ctypes.c_int64)
+    pptr = ctypes.POINTER(ctypes.c_void_p)
+    lib.hostio_write.restype = ctypes.c_int
+    lib.hostio_write.argtypes = [ctypes.c_char_p, i64, p64, p64, pptr,
+                                 ctypes.c_int]
+    lib.hostio_read.restype = ctypes.c_int
+    lib.hostio_read.argtypes = [ctypes.c_char_p, i64, p64, p64, pptr,
+                                ctypes.c_int]
+    lib.hostio_write_fd.restype = ctypes.c_int
+    lib.hostio_write_fd.argtypes = [ctypes.c_int, i64, p64, p64, pptr,
+                                    ctypes.c_int]
+    lib.hostio_read_fd.restype = ctypes.c_int
+    lib.hostio_read_fd.argtypes = [ctypes.c_int, i64, p64, p64, pptr,
+                                   ctypes.c_int]
+    lib.hostio_file_size.restype = i64
+    lib.hostio_file_size.argtypes = [ctypes.c_char_p]
+    lib.hostio_pack.restype = ctypes.c_int
+    lib.hostio_pack.argtypes = [ctypes.c_void_p, i64, pptr, p64, p64,
+                                ctypes.c_int]
+    lib.hostio_unpack.restype = ctypes.c_int
+    lib.hostio_unpack.argtypes = [ctypes.c_void_p, i64, pptr, p64, p64,
+                                  ctypes.c_int]
+    return lib
+
+
+def load_hostio() -> Optional[ctypes.CDLL]:
+    """The hostio native library, building it on first call. ``None`` if
+    the build fails (no g++ / sandboxed FS) — callers must fall back."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("APEX_TPU_DISABLE_NATIVE"):
+            return None
+        try:
+            with open(_SRC, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        except OSError:
+            return None
+        so = os.path.join(_DIR, f"_hostio_{tag}.so")
+        if not os.path.exists(so):
+            tmp = so + f".tmp{os.getpid()}"
+            if not _build(_SRC, tmp):
+                return None
+            os.replace(tmp, so)  # atomic vs concurrent builders
+        try:
+            _lib = _configure(ctypes.CDLL(so))
+        except OSError:
+            return None
+        return _lib

@@ -1,0 +1,250 @@
+"""Host-side tensor IO + bucket packing over the native hostio engine.
+
+Python surface of ``apex_tpu/csrc/hostio.cpp`` — the TPU-native layer for
+the reference's host/native runtime components:
+
+- ``write_arrays`` / ``read_arrays``: offset-based multithreaded
+  tensor<->file IO (the ``csrc/gpu_direct_storage/gds.cpp`` capability —
+  on TPU hosts there is no cuFile-style device-direct path since XLA owns
+  HBM; what a native engine can attack is host file bandwidth).
+- ``flatten`` / ``unflatten``: many-buffers <-> one-arena parallel
+  gather/scatter (the ``csrc/flatten_unflatten.cpp`` / ``apex_C``
+  capability, host-side: checkpoint packing, flat send buffers).
+
+The thread pool is sized for real TPU hosts (dozens of cores, NVMe-backed
+storage, where parallel pread/pwrite scales); on a 1-core CI container it
+measures at parity with buffered Python IO — the component's value there
+is native-runtime parity of form, not a measured speedup. Every entry
+point works without the native library (pure-NumPy fallback) so
+environments without a toolchain degrade gracefully; ``native_available()``
+reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.csrc import load_hostio
+
+_DEFAULT_THREADS = 8
+
+
+def native_available() -> bool:
+    return load_hostio() is not None
+
+
+def _as_host(arrays) -> List[np.ndarray]:
+    """Contiguous host views of the inputs (device arrays are fetched)."""
+    out = []
+    for a in arrays:
+        if not isinstance(a, np.ndarray):
+            import jax
+
+            a = jax.device_get(a)
+        out.append(np.ascontiguousarray(a))
+    return out
+
+
+def _ptrs(arrays: Sequence[np.ndarray], writable: bool):
+    ptrs = (ctypes.c_void_p * len(arrays))()
+    for i, a in enumerate(arrays):
+        if writable and not a.flags.writeable:
+            raise ValueError("read target buffers must be writable")
+        ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
+    return ptrs
+
+
+def _i64(vals) -> "ctypes.Array":
+    return (ctypes.c_int64 * len(vals))(*[int(v) for v in vals])
+
+
+def _check(rc: int, what: str) -> None:
+    if rc != 0:
+        import os
+
+        raise OSError(-rc, f"hostio {what} failed: {os.strerror(-rc)}")
+
+
+def layout(arrays: Sequence[np.ndarray],
+           align: int = 64) -> Tuple[List[int], int]:
+    """(offsets, total) laying the arrays out back-to-back, each chunk
+    aligned to ``align`` bytes."""
+    offsets, off = [], 0
+    for a in arrays:
+        off = (off + align - 1) // align * align
+        offsets.append(off)
+        off += a.nbytes
+    return offsets, off
+
+
+def _check_counts(offsets, n: int, what: str) -> None:
+    if len(offsets) != n:
+        raise ValueError(
+            f"{what}: got {len(offsets)} offsets for {n} arrays"
+        )
+
+
+def write_arrays(
+    path,  # str path, or an int fd held open by the caller
+    arrays,
+    offsets: Optional[Sequence[int]] = None,
+    threads: int = _DEFAULT_THREADS,
+) -> List[int]:
+    """Write each array's raw bytes at its offset (default: aligned
+    back-to-back layout); returns the offsets used. ``path`` may be an
+    open writable fd to amortise open/close over many calls."""
+    host = _as_host(arrays)
+    if offsets is None:
+        offsets, _ = layout(host)
+    _check_counts(offsets, len(host), "write_arrays")
+    lib = load_hostio()
+    sizes = _i64([a.nbytes for a in host])
+    if lib is not None:
+        if isinstance(path, int):
+            rc = lib.hostio_write_fd(
+                path, len(host), _i64(offsets), sizes, _ptrs(host, False),
+                int(threads),
+            )
+        else:
+            rc = lib.hostio_write(
+                path.encode(), len(host), _i64(offsets), sizes,
+                _ptrs(host, False), int(threads),
+            )
+        _check(rc, "write")
+    else:  # pure-Python fallback
+        import os
+
+        if isinstance(path, int):
+            for a, off in zip(host, offsets):
+                os.pwrite(path, a.tobytes(), off)
+        else:
+            with open(path, "r+b" if _exists(path) else "wb") as f:
+                for a, off in zip(host, offsets):
+                    f.seek(off)
+                    f.write(a.tobytes())
+    return list(offsets)
+
+
+def read_arrays(
+    path,  # str path, or an int fd held open by the caller
+    templates,
+    offsets: Sequence[int],
+    threads: int = _DEFAULT_THREADS,
+) -> List[np.ndarray]:
+    """Read one array per (template, offset): raw bytes reinterpreted with
+    the template's shape/dtype (accepts arrays or (shape, dtype) pairs)."""
+    outs = []
+    for t in templates:
+        if isinstance(t, tuple):
+            shape, dtype = t
+        else:
+            shape, dtype = t.shape, t.dtype
+        outs.append(np.empty(shape, dtype=dtype))
+    _check_counts(offsets, len(outs), "read_arrays")
+    lib = load_hostio()
+    sizes = _i64([a.nbytes for a in outs])
+    if lib is not None:
+        if isinstance(path, int):
+            rc = lib.hostio_read_fd(
+                path, len(outs), _i64(offsets), sizes, _ptrs(outs, True),
+                int(threads),
+            )
+        else:
+            rc = lib.hostio_read(
+                path.encode(), len(outs), _i64(offsets), sizes,
+                _ptrs(outs, True), int(threads),
+            )
+        _check(rc, "read")
+    else:
+        import os
+
+        def _fill(a, buf, off):
+            if len(buf) != a.nbytes:
+                raise EOFError(f"expected {a.nbytes} bytes at {off}")
+            a[...] = np.frombuffer(buf, dtype=a.dtype).reshape(a.shape)
+
+        if isinstance(path, int):
+            for a, off in zip(outs, offsets):
+                _fill(a, os.pread(path, a.nbytes, off), off)
+        else:
+            with open(path, "rb") as f:
+                for a, off in zip(outs, offsets):
+                    f.seek(off)
+                    _fill(a, f.read(a.nbytes), off)
+    return outs
+
+
+def flatten(
+    arrays, align: int = 64, threads: int = _DEFAULT_THREADS
+) -> Tuple[np.ndarray, List[int]]:
+    """Pack host arrays into one contiguous uint8 arena (parallel
+    gather); returns (arena, per-array byte offsets). The host-side
+    ``apex_C.flatten`` analogue."""
+    host = _as_host(arrays)
+    offsets, total = layout(host, align)
+    arena = np.zeros(total, np.uint8)
+    lib = load_hostio()
+    if lib is not None:
+        rc = lib.hostio_pack(
+            arena.ctypes.data_as(ctypes.c_void_p), len(host),
+            _ptrs(host, False), _i64([a.nbytes for a in host]),
+            _i64(offsets), int(threads),
+        )
+        _check(rc, "pack")
+    else:
+        for a, off in zip(host, offsets):
+            arena[off:off + a.nbytes] = np.frombuffer(
+                a.tobytes(), np.uint8
+            )
+    return arena, offsets
+
+
+def unflatten(
+    arena: np.ndarray,
+    templates,
+    offsets: Sequence[int],
+    threads: int = _DEFAULT_THREADS,
+) -> List[np.ndarray]:
+    """Scatter arena slices back out into fresh arrays shaped like the
+    templates (``apex_C.unflatten``)."""
+    arena = np.ascontiguousarray(arena).reshape(-1).view(np.uint8)
+    outs = []
+    for t in templates:
+        if isinstance(t, tuple):
+            shape, dtype = t
+        else:
+            shape, dtype = t.shape, t.dtype
+        outs.append(np.empty(shape, dtype=dtype))
+    _check_counts(offsets, len(outs), "unflatten")
+    lib = load_hostio()
+    if lib is not None:
+        rc = lib.hostio_unpack(
+            arena.ctypes.data_as(ctypes.c_void_p), len(outs),
+            _ptrs(outs, True), _i64([a.nbytes for a in outs]),
+            _i64(offsets), int(threads),
+        )
+        _check(rc, "unpack")
+    else:
+        for a, off in zip(outs, offsets):
+            a[...] = arena[off:off + a.nbytes].view(a.dtype).reshape(a.shape)
+    return outs
+
+
+def file_size(path: str) -> int:
+    lib = load_hostio()
+    if lib is not None:
+        n = lib.hostio_file_size(path.encode())
+        if n < 0:
+            _check(int(n), "stat")
+        return int(n)
+    import os
+
+    return os.path.getsize(path)
+
+
+def _exists(path: str) -> bool:
+    import os
+
+    return os.path.exists(path)
