@@ -32,9 +32,10 @@ operands are already head-sharded under TP — ring CP multiplies on top).
 Causal ring scheduling: step 0 is the local causal block; step t>0 visits
 chunk ``(i-t) mod cp``, which is entirely in the past for ranks ``i >= t``
 and entirely in the future (fully masked, contributes nothing) otherwise.
-Devices therefore idle-compute masked chunks for ~half the steps — the
-plain-ordering bubble; a zigzag layout would balance it and can be layered
-on without changing this core.
+With the plain contiguous layout devices idle-compute masked chunks for
+~half the steps; ``zigzag=True`` (with :func:`zigzag_indices` providing
+the layout: rank r holds global chunks ``(r, 2cp-1-r)``) balances this to
+exactly two live half-chunk attentions per device per step.
 """
 from __future__ import annotations
 
@@ -124,16 +125,209 @@ def _merge(o_acc, lse_acc, o_j, lse_j):
     return o_acc * w_acc + o_j.astype(o_acc.dtype) * w_j, lse_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+def zigzag_indices(s: int, cp: int):
+    """Permutation laying the global sequence out in zigzag order: with
+    2*cp equal chunks, rank r owns chunks (r, 2cp-1-r). ``x[perm]``
+    reordered then sharded contiguously over the cp axis gives every rank
+    one early and one late chunk, so each ring step carries ~equal causal
+    work (the load-balancing trick of llama3-style context parallelism).
+    Returns (perm, inv_perm) index arrays of length s."""
+    import numpy as np
+
+    if s % (2 * cp) != 0:
+        raise ValueError(f"seq {s} must divide into 2*cp={2 * cp} chunks")
+    h = s // (2 * cp)
+    order = []
+    for r in range(cp):
+        order.extend([r, 2 * cp - 1 - r])
+    perm = np.concatenate(
+        [np.arange(c * h, (c + 1) * h) for c in order]
+    )
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(s)
+    return perm, inv
+
+
+def _zig_select(pred, x1, x2):
+    """Device-varying half-select (static shapes): ``x1`` where ``pred``
+    else ``x2``. Shared by the zigzag forward and backward so the live
+    A-vs-D choice can never diverge between them."""
+    return jnp.where(pred, x1, x2)
+
+
+def _zig_fwd_step(q1, q2, k1, k2, v1, v2, t, i, scale, block_q, block_k,
+                  interpret):
+    """One zigzag ring step: sub-attentions of the local q halves (global
+    chunks a1=i, a2=2cp-1-i) against the visiting kv halves (chunks
+    c1=j, c2=2cp-1-j, j=(i-t)%cp). Chunk-causal order gives:
+    A=q1xkv1 (full j<i / causal j==i / skip j>i), q1xkv2 never attends,
+    C=q2xkv1 always full, D=q2xkv2 (full j>i / causal j==i / skip j<i).
+    For t>0 exactly ONE of A/D lives per device (j<i <=> i>=t), so the
+    live pair is where-selected and stacked with C — two half-chunk
+    attentions per device per step, zero dead compute.
+    Returns ((o_sel, l_sel, pred: sel is A), (oC, lC))."""
+    b, _, h, _ = q1.shape
+    if t == 0:  # j == i on every device: A and D causal, C full
+        oAD, lAD = _chunk_fwd(jnp.concatenate([q1, q2]),
+                              jnp.concatenate([k1, k2]),
+                              jnp.concatenate([v1, v2]),
+                              None, scale, True, block_q, block_k,
+                              interpret)
+        oC, lC = _chunk_fwd(q2, k1, v1, None, scale, False, block_q,
+                            block_k, interpret)
+        # t=0 computes BOTH diagonals; report them as "A" (q1 rows) and
+        # fold D into the C slot's merge by the caller
+        return (oAD[:b], lAD[:b], None), (oC, lC), (oAD[b:], lAD[b:])
+    pred = i >= t  # A (q1 x kv1) lives; else D (q2 x kv2)
+    q_sel = _zig_select(pred, q1, q2)
+    k_sel = _zig_select(pred, k1, k2)
+    v_sel = _zig_select(pred, v1, v2)
+    o, l = _chunk_fwd(
+        jnp.concatenate([q_sel, q2]), jnp.concatenate([k_sel, k1]),
+        jnp.concatenate([v_sel, v1]), None, scale, False, block_q,
+        block_k, interpret,
+    )
+    return (o[:b], l[:b], pred), (o[b:], l[b:]), None
+
+
+def _ring_fwd_zigzag(q, k, v, axis_name, scale, block_q, block_k,
+                     interpret):
+    b, n, s_loc, d = q.shape
+    if s_loc % 2 != 0:
+        raise ValueError("zigzag needs an even local sequence length")
+    h = s_loc // 2
+    cp = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    q1, q2 = q[:, :, :h], q[:, :, h:]
+
+    o1 = jnp.zeros((b, n, h, d), jnp.float32)
+    o2 = jnp.zeros((b, n, h, d), jnp.float32)
+    l1 = jnp.full((b, n, h), -1e30, jnp.float32)
+    l2 = jnp.full((b, n, h), -1e30, jnp.float32)
+    k_t, v_t = k, v
+    for t in range(cp):
+        (o_sel, l_sel, pred), (oC, lC), d_part = _zig_fwd_step(
+            q1, q2, k_t[:, :, :h], k_t[:, :, h:], v_t[:, :, :h],
+            v_t[:, :, h:], t, i, scale, block_q, block_k, interpret,
+        )
+        if pred is None:  # t == 0: both diagonals computed
+            o1, l1 = _merge(o1, l1, o_sel, l_sel)
+            oD, lD = d_part
+            o2, l2 = _merge(o2, l2, oD, lD)
+        else:
+            # scatter the selected result to the half it belongs to; the
+            # other half gets a neutral (-inf lse, zero o) contribution
+            neg = jnp.full_like(l_sel, -1e30)
+            zero = jnp.zeros_like(o_sel, jnp.float32)
+            o1, l1 = _merge(
+                o1, l1, jnp.where(pred, o_sel, zero.astype(o_sel.dtype)),
+                jnp.where(pred, l_sel, neg),
+            )
+            o2, l2 = _merge(
+                o2, l2, jnp.where(pred, zero.astype(o_sel.dtype), o_sel),
+                jnp.where(pred, neg, l_sel),
+            )
+        o2, l2 = _merge(o2, l2, oC, lC)
+        if t != cp - 1:
+            k_t, v_t = _shift((k_t, v_t), axis_name)
+    o = jnp.concatenate([o1, o2], axis=2).astype(q.dtype)
+    lse = jnp.concatenate([l1, l2], axis=2)
+    return o, lse
+
+
+def _ring_bwd_zigzag(q, k, v, o, lse, do, axis_name, scale, block_q,
+                     block_k, interpret):
+    b, n, s_loc, d = q.shape
+    h = s_loc // 2
+    cp = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    q1, q2 = q[:, :, :h], q[:, :, h:]
+    o1, o2 = o[:, :, :h], o[:, :, h:]
+    l1, l2 = lse[:, :, :h], lse[:, :, h:]
+    do1, do2 = do[:, :, :h], do[:, :, h:]
+
+    dq1 = jnp.zeros(q1.shape, jnp.float32)
+    dq2 = jnp.zeros(q2.shape, jnp.float32)
+    k_t, v_t = k, v
+    dkv = jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)
+    for t in range(cp):
+        k1, k2 = k_t[:, :, :h], k_t[:, :, h:]
+        v1, v2 = v_t[:, :, :h], v_t[:, :, h:]
+        if t == 0:
+            dqAD, dkAD, dvAD = _chunk_bwd(
+                jnp.concatenate([q1, q2]), jnp.concatenate([k1, k2]),
+                jnp.concatenate([v1, v2]), None,
+                jnp.concatenate([o1, o2]), jnp.concatenate([l1, l2]),
+                jnp.concatenate([do1, do2]), scale, True, block_q,
+                block_k, interpret,
+            )
+            dqC, dkC, dvC = _chunk_bwd(
+                q2, k1, v1, None, o2, l2, do2, scale, False, block_q,
+                block_k, interpret,
+            )
+            dq1 = dq1 + dqAD[:b].astype(jnp.float32)
+            dq2 = dq2 + (dqAD[b:] + dqC).astype(jnp.float32)
+            dk1 = dkAD[:b].astype(jnp.float32) + dkC.astype(jnp.float32)
+            dk2 = dkAD[b:].astype(jnp.float32)
+            dv1 = dvAD[:b].astype(jnp.float32) + dvC.astype(jnp.float32)
+            dv2 = dvAD[b:].astype(jnp.float32)
+        else:
+            # same live-pair selection as the forward (_zig_select keeps
+            # the predicates shared): one stacked [selected; C] backward
+            pred = i >= t
+            q_sel = _zig_select(pred, q1, q2)
+            k_sel = _zig_select(pred, k1, k2)
+            v_sel = _zig_select(pred, v1, v2)
+            dqS, dkS, dvS = _chunk_bwd(
+                jnp.concatenate([q_sel, q2]),
+                jnp.concatenate([k_sel, k1]),
+                jnp.concatenate([v_sel, v1]), None,
+                jnp.concatenate([_zig_select(pred, o1, o2), o2]),
+                jnp.concatenate([_zig_select(pred, l1, l2), l2]),
+                jnp.concatenate([_zig_select(pred, do1, do2), do2]),
+                scale, False, block_q, block_k, interpret,
+            )
+            dq_sel = dqS[:b].astype(jnp.float32)
+            dk_sel = dkS[:b].astype(jnp.float32)
+            dv_sel = dvS[:b].astype(jnp.float32)
+            zero = jnp.zeros_like(dq_sel)
+            dq1 = dq1 + jnp.where(pred, dq_sel, zero)
+            dq2 = dq2 + jnp.where(pred, zero, dq_sel) \
+                + dqS[b:].astype(jnp.float32)
+            dk1 = jnp.where(pred, dk_sel, zero) + dkS[b:].astype(jnp.float32)
+            dk2 = jnp.where(pred, zero, dk_sel)
+            dv1 = jnp.where(pred, dv_sel, zero) + dvS[b:].astype(jnp.float32)
+            dv2 = jnp.where(pred, zero, dv_sel)
+        dk_acc, dv_acc = dkv
+        dkv = (
+            dk_acc + jnp.concatenate([dk1, dk2], axis=2),
+            dv_acc + jnp.concatenate([dv1, dv2], axis=2),
+        )
+        if t != cp - 1:
+            k_t, v_t, dkv = _shift((k_t, v_t, dkv), axis_name)
+        else:
+            dkv = _shift(dkv, axis_name)
+    dq = jnp.concatenate([dq1, dq2], axis=2)
+    return (dq.astype(q.dtype), dkv[0].astype(k.dtype),
+            dkv[1].astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring(q, k, v, axis_name, causal, scale, block_q, block_k, interpret,
+          zigzag=False):
     o, _ = _ring_fwd_impl(
-        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret,
+        zigzag,
     )
     return o
 
 
 def _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
-                   interpret):
+                   interpret, zigzag=False):
+    if zigzag and causal:
+        return _ring_fwd_zigzag(
+            q, k, v, axis_name, scale, block_q, block_k, interpret
+        )
     b, n, s_loc, d = q.shape
     cp = jax.lax.axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
@@ -159,16 +353,22 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
 
 
 def _ring_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
-              interpret):
+              interpret, zigzag=False):
     o, lse = _ring_fwd_impl(
-        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret,
+        zigzag,
     )
     return o, (q, k, v, o, lse)
 
 
 def _ring_bwd(axis_name, causal, scale, block_q, block_k, interpret,
-              res, do):
+              zigzag, res, do):
     q, k, v, o, lse = res
+    if zigzag and causal:
+        return _ring_bwd_zigzag(
+            q, k, v, o, lse, do, axis_name, scale, block_q, block_k,
+            interpret,
+        )
     b, n, s_loc, d = q.shape
     cp = jax.lax.axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
@@ -214,6 +414,7 @@ def ring_attention(
     *,
     axis_name: str,
     causal: bool = False,
+    zigzag: bool = False,
     scale: Optional[float] = None,
     block_q: int = 1024,
     block_k: int = 1024,
@@ -223,6 +424,14 @@ def ring_attention(
     ``shard_map``). Sequence shards are laid out contiguously by rank:
     global position = ``rank * s_local + local position`` (causal masking
     uses exactly this order). Returns this rank's output shard.
+
+    ``zigzag=True`` (causal only) assumes the zigzag layout instead: with
+    2*cp global chunks, rank r holds chunks ``(r, 2cp-1-r)`` concatenated
+    (:func:`zigzag_indices` produces the permutation). Every ring step
+    then carries exactly two live chunk-attentions per device instead of
+    the plain ordering's all-or-nothing masked steps — the causal
+    load-balance trick. With ``causal=False`` the flag is ignored (plain
+    ring is already balanced).
 
     Dropout is not supported on the CP path (the per-chunk kernels would
     need globally-consistent counters); apply dropout outside attention
@@ -234,7 +443,7 @@ def ring_attention(
         interpret = True
     return _ring(
         q, k, v, axis_name, bool(causal), float(scale), int(block_q),
-        int(block_k), bool(interpret),
+        int(block_k), bool(interpret), bool(zigzag),
     )
 
 

@@ -144,3 +144,84 @@ def test_ulysses_dropout_runs_and_is_seeded():
         mesh,
     )
     assert jnp.abs(fn2(q, k, v) - o1).max() > 0.0
+
+
+# ---------------------------------------------------------------------------
+# zigzag ring (causal load balance)
+# ---------------------------------------------------------------------------
+
+
+def _zig(x, perm):
+    return x[:, :, perm, :]
+
+
+def test_zigzag_indices_shape_and_inverse():
+    from apex_tpu.transformer.context_parallel import zigzag_indices
+
+    perm, inv = zigzag_indices(S, CP)
+    assert sorted(perm.tolist()) == list(range(S))
+    assert (perm[inv] == np.arange(S)).all()
+    # rank 0's shard = chunks 0 and 2cp-1
+    h = S // (2 * CP)
+    s_loc = S // CP
+    assert perm[:h].tolist() == list(range(0, h))
+    assert perm[h:s_loc].tolist() == list(range((2 * CP - 1) * h, 2 * CP * h))
+    with pytest.raises(ValueError, match="chunks"):
+        zigzag_indices(10, 4)
+
+
+def test_zigzag_ring_matches_dense_reference():
+    from apex_tpu.transformer.context_parallel import zigzag_indices
+
+    q, k, v = _qkv(7)
+    perm, inv = zigzag_indices(S, CP)
+    mesh = _mesh()
+    fn = _sharded(
+        functools.partial(ring_attention, axis_name="cp", causal=True,
+                          zigzag=True, block_q=8, block_k=8),
+        mesh,
+    )
+    out = fn(_zig(q, perm), _zig(k, perm), _zig(v, perm))[:, :, inv, :]
+    ref = mha_reference(q, k, v, causal=True)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_zigzag_ring_grads_match_dense_reference():
+    from apex_tpu.transformer.context_parallel import zigzag_indices
+
+    q, k, v = _qkv(8)
+    perm, inv = zigzag_indices(S, CP)
+    mesh = _mesh()
+    ring = _sharded(
+        functools.partial(ring_attention, axis_name="cp", causal=True,
+                          zigzag=True, block_q=8, block_k=8),
+        mesh,
+    )
+
+    def loss_zig(q, k, v):
+        out = ring(_zig(q, perm), _zig(k, perm), _zig(v, perm))
+        return jnp.sum(out[:, :, inv, :] ** 2)
+
+    gf = jax.grad(loss_zig, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(mha_reference(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        assert jnp.abs(a - b).max() < 5e-4
+
+
+def test_zigzag_noncausal_falls_back_to_plain_ring():
+    q, k, v = _qkv(9)
+    mesh = _mesh()
+    plain = _sharded(
+        functools.partial(ring_attention, axis_name="cp", causal=False,
+                          block_q=8, block_k=8),
+        mesh,
+    )
+    zig = _sharded(
+        functools.partial(ring_attention, axis_name="cp", causal=False,
+                          zigzag=True, block_q=8, block_k=8),
+        mesh,
+    )
+    assert jnp.abs(plain(q, k, v) - zig(q, k, v)).max() == 0.0
