@@ -73,6 +73,15 @@ class GPTConfig:
     # None = auto (Pallas flash attention when available & applicable);
     # True forces it (errors if inapplicable); False forces the XLA path.
     use_flash_attention: Optional[bool] = None
+    # Context parallelism (long context): name of a mesh axis the SEQUENCE
+    # is sharded over end-to-end — attention runs as ring attention over
+    # that axis (apex_tpu.transformer.context_parallel). Composable with
+    # the TP axis; mutually exclusive with sequence_parallel (Megatron SP
+    # gathers the full sequence inside the block). zigzag selects the
+    # load-balanced layout (rank r holds global chunks (r, 2cp-1-r);
+    # zigzag_indices builds the permutation).
+    context_parallel_axis: Optional[str] = None
+    context_parallel_zigzag: bool = False
     # BERT extras
     add_binary_head: bool = False
 
@@ -219,6 +228,48 @@ def parallel_attention(
         and layer_number is not None
     )
 
+    # --- context-parallel path (ring attention over the cp axis) --------
+    if cfg.context_parallel_axis is not None:
+        from apex_tpu.transformer.context_parallel import ring_attention
+
+        if cfg.attn_mask_type != AttnMaskType.causal:
+            raise ValueError(
+                "context parallelism supports causal attention only"
+            )
+        if cfg.sequence_parallel:
+            raise ValueError(
+                "context_parallel_axis and sequence_parallel are mutually "
+                "exclusive (Megatron SP gathers the full sequence inside "
+                "the block; CP keeps it sharded end-to-end)"
+            )
+        if qk_scaling:
+            raise ValueError(
+                "context parallelism needs a static softmax scale; disable "
+                "apply_query_key_layer_scaling (fp16 layer scaling)"
+            )
+        if cfg.attention_dropout > 0.0 and not deterministic \
+                and dropout_key is not None:
+            raise ValueError(
+                "attention dropout is not supported on the ring-attention "
+                "path; set attention_dropout=0 (hidden dropout still works)"
+            )
+        if cfg.use_flash_attention is False:
+            raise ValueError(
+                "use_flash_attention=False cannot be honored under "
+                "context parallelism: ring attention runs the flash chunk "
+                "kernels internally"
+            )
+        qb = jnp.transpose(q, (1, 2, 0, 3))   # [s,b,np,hn] -> [b,np,s,hn]
+        kb = jnp.transpose(kk, (1, 2, 0, 3))
+        vb = jnp.transpose(vv, (1, 2, 0, 3))
+        ctx = ring_attention(
+            qb, kb, vb, axis_name=cfg.context_parallel_axis, causal=True,
+            zigzag=cfg.context_parallel_zigzag,
+            scale=1.0 / (hn ** 0.5),
+        ).astype(hidden.dtype)
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, np_local * hn)
+        return _attn_out_proj(cfg, lp, ctx, axis_name)
+
     # --- flash attention path (Pallas, O(s) memory) ---------------------
     # Replaces the materialised-[b,np,sq,sk] scores below when applicable:
     # no traced per-layer scaling, and a mask expressible as causal or
@@ -329,6 +380,12 @@ def parallel_attention(
         ).astype(hidden.dtype)
         ctx = ctx.reshape(s, b, np_local * hn)
 
+    return _attn_out_proj(cfg, lp, ctx, axis_name)
+
+
+def _attn_out_proj(cfg, lp, ctx, axis_name):
+    """Row-parallel (or dense) attention output projection, shared by the
+    flash/XLA and ring-attention context-parallel paths."""
     if axis_name is not None:
         out, _ = row_parallel_linear(
             ctx, lp["proj_w"].astype(ctx.dtype),
@@ -475,7 +532,7 @@ def gpt_embed(
     """Word + position embeddings → [s, b, h] (reference ``Embedding``)."""
     if position_ids is None:
         position_ids = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1]), tokens.shape
+            _local_position_ids(cfg, tokens.shape[1]), tokens.shape
         )
     if axis_name is not None:
         word = vocab_parallel_embedding(
@@ -493,6 +550,28 @@ def gpt_embed(
         # dropout below then acts on the local slice
         emb = mappings.scatter_to_sequence_parallel_region(emb, axis_name)
     return _dropout(emb, cfg.hidden_dropout, dropout_key, deterministic)
+
+
+def _local_position_ids(cfg: GPTConfig, s_loc: int) -> jax.Array:
+    """[s_loc] GLOBAL position ids of this rank's tokens. Without context
+    parallelism that is just arange; under CP the shard's global offset
+    (contiguous: rank*s_loc; zigzag: rank's two chunks r and 2cp-1-r)."""
+    if cfg.context_parallel_axis is None:
+        return jnp.arange(s_loc)
+    r = jax.lax.axis_index(cfg.context_parallel_axis)
+    if cfg.context_parallel_zigzag:
+        if s_loc % 2 != 0:
+            raise ValueError(
+                "zigzag needs an even local sequence length, got "
+                f"{s_loc} tokens per rank"
+            )
+        cp = jax.lax.axis_size(cfg.context_parallel_axis)
+        h = s_loc // 2
+        return jnp.concatenate([
+            r * h + jnp.arange(h),
+            (2 * cp - 1 - r) * h + jnp.arange(h),
+        ])
+    return r * s_loc + jnp.arange(s_loc)
 
 
 def gpt_hidden(
@@ -514,6 +593,11 @@ def gpt_hidden(
             # fork, ``tensor_parallel/random.py`` seed+2718+tp_rank)
             dropout_key = jax.random.fold_in(
                 dropout_key, jax.lax.axis_index(axis_name)
+            )
+        if cfg.context_parallel_axis is not None:
+            # each cp rank holds different tokens: fork hidden-dropout too
+            dropout_key = jax.random.fold_in(
+                dropout_key, jax.lax.axis_index(cfg.context_parallel_axis)
             )
         k_embed, k_block = jax.random.split(dropout_key)
     hidden = gpt_embed(
@@ -616,6 +700,15 @@ def gpt_loss(
             chunk_size=chunk,
         ).reshape(s, b)
         losses = jnp.transpose(losses, (1, 0))  # [b, s]
+    if cfg.context_parallel_axis is not None:
+        # global masked mean over the sequence-sharded losses: psum the
+        # numerator/denominator over the cp axis (equal shard sizes)
+        a = cfg.context_parallel_axis
+        m = (jnp.ones_like(losses) if loss_mask is None
+             else loss_mask.astype(jnp.float32))
+        num = jax.lax.psum(jnp.sum(losses * m), a)
+        den = jax.lax.psum(jnp.sum(m), a)
+        return num / jnp.maximum(den, 1.0)
     if loss_mask is None:
         return jnp.mean(losses)
     m = loss_mask.astype(jnp.float32)

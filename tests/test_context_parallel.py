@@ -225,3 +225,132 @@ def test_zigzag_noncausal_falls_back_to_plain_ring():
         mesh,
     )
     assert jnp.abs(plain(q, k, v) - zig(q, k, v)).max() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: GPT with context-parallel ring attention
+# ---------------------------------------------------------------------------
+
+
+def _cp_gpt_cfg(**kw):
+    from apex_tpu.transformer.testing import GPTConfig
+
+    return GPTConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4, vocab_size=64,
+        max_position_embeddings=S, hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, **kw,
+    )
+
+
+@pytest.mark.parametrize("zigzag", [False, True])
+def test_gpt_context_parallel_matches_dense(zigzag):
+    """Full GPT loss + param grads with the sequence sharded end-to-end
+    over cp=4 (ring attention, global position ids, psum'd loss) must
+    equal the dense single-device model."""
+    from apex_tpu.transformer.context_parallel import zigzag_indices
+    from apex_tpu.transformer.testing import init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import gpt_loss
+
+    cfg_cp = _cp_gpt_cfg(context_parallel_axis="cp",
+                         context_parallel_zigzag=zigzag)
+    cfg_dense = _cp_gpt_cfg()
+    params = init_gpt_params(cfg_dense, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+    labels = jnp.roll(tokens, -1, axis=1)
+    if zigzag:
+        perm, _ = zigzag_indices(S, CP)
+        tokens_sh, labels_sh = tokens[:, perm], labels[:, perm]
+    else:
+        tokens_sh, labels_sh = tokens, labels
+
+    mesh = _mesh()
+    tspec = P(None, "cp")
+    pspec = jax.tree_util.tree_map(lambda _: P(), params)
+    loss_cp = shard_map(
+        lambda p, t, l: gpt_loss(cfg_cp, p, t, l),
+        mesh=mesh, in_specs=(pspec, tspec, tspec), out_specs=P(),
+    )
+
+    lc = loss_cp(params, tokens_sh, labels_sh)
+    ld = gpt_loss(cfg_dense, params, tokens, labels)
+    assert jnp.abs(lc - ld) < 1e-5
+
+    gc = jax.grad(lambda p: loss_cp(p, tokens_sh, labels_sh))(params)
+    gd = jax.grad(lambda p: gpt_loss(cfg_dense, p, tokens, labels))(params)
+    flat_c = jax.tree_util.tree_leaves(gc)
+    flat_d = jax.tree_util.tree_leaves(gd)
+    for a, b in zip(flat_c, flat_d):
+        assert jnp.abs(a - b).max() < 2e-4
+
+
+def test_gpt_context_parallel_validations():
+    from apex_tpu.transformer.testing import init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import gpt_loss
+
+    mesh = _mesh()
+    cfg = _cp_gpt_cfg(context_parallel_axis="cp", sequence_parallel=True)
+    params = init_gpt_params(_cp_gpt_cfg(), jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+    fn = shard_map(
+        lambda p, t: gpt_loss(cfg, p, t, t),
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P(None, "cp")),
+        out_specs=P(),
+    )
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        fn(params, tokens)
+
+
+@pytest.mark.parametrize("bad_kw,match", [
+    (dict(apply_query_key_layer_scaling=True,
+          compute_dtype=jnp.float16), "static softmax scale"),
+    (dict(use_flash_attention=False), "cannot be honored"),
+])
+def test_gpt_context_parallel_more_validations(bad_kw, match):
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import gpt_loss
+
+    mesh = _mesh()
+    base = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
+                vocab_size=64, max_position_embeddings=S,
+                hidden_dropout=0.0, attention_dropout=0.0,
+                apply_query_key_layer_scaling=False,
+                context_parallel_axis="cp")
+    base.update(bad_kw)
+    cfg = GPTConfig(**base)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+    fn = shard_map(
+        lambda p, t: gpt_loss(cfg, p, t, t),
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P(None, "cp")),
+        out_specs=P(),
+    )
+    with pytest.raises(ValueError, match=match):
+        fn(params, tokens)
+
+
+def test_gpt_context_parallel_attention_dropout_raises():
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import gpt_loss
+
+    mesh = _mesh()
+    cfg = GPTConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4, vocab_size=64,
+        max_position_embeddings=S, hidden_dropout=0.0, attention_dropout=0.2,
+        apply_query_key_layer_scaling=False, context_parallel_axis="cp",
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+    fn = shard_map(
+        lambda p, t, k: gpt_loss(cfg, p, t, t, dropout_key=k,
+                                 deterministic=False),
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P(None, "cp"), P()),
+        out_specs=P(),
+    )
+    with pytest.raises(ValueError, match="attention dropout"):
+        fn(params, tokens, jax.random.PRNGKey(3))
