@@ -259,6 +259,13 @@ def parallel_attention(
                 "context parallelism: ring attention runs the flash chunk "
                 "kernels internally"
             )
+        if s % 8 != 0 or hn > 256:
+            # same loud every-backend gate as the forced-flash path: the
+            # ring path compiles the Pallas chunk kernels on TPU
+            raise ValueError(
+                f"context parallelism needs kernel-tileable shapes (local "
+                f"seq {s} % 8 == 0 and head dim {hn} <= 256)"
+            )
         qb = jnp.transpose(q, (1, 2, 0, 3))   # [s,b,np,hn] -> [b,np,s,hn]
         kb = jnp.transpose(kk, (1, 2, 0, 3))
         vb = jnp.transpose(vv, (1, 2, 0, 3))
@@ -558,6 +565,14 @@ def _local_position_ids(cfg: GPTConfig, s_loc: int) -> jax.Array:
     (contiguous: rank*s_loc; zigzag: rank's two chunks r and 2cp-1-r)."""
     if cfg.context_parallel_axis is None:
         return jnp.arange(s_loc)
+    cp_size = jax.lax.axis_size(cfg.context_parallel_axis)
+    if cp_size * s_loc > cfg.max_position_embeddings:
+        # jnp.take would clamp out-of-range ids silently — every token on
+        # later ranks would share the table's last row
+        raise ValueError(
+            f"global sequence {cp_size}*{s_loc}={cp_size * s_loc} exceeds "
+            f"max_position_embeddings={cfg.max_position_embeddings}"
+        )
     r = jax.lax.axis_index(cfg.context_parallel_axis)
     if cfg.context_parallel_zigzag:
         if s_loc % 2 != 0:

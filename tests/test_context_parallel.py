@@ -354,3 +354,53 @@ def test_gpt_context_parallel_attention_dropout_raises():
     )
     with pytest.raises(ValueError, match="attention dropout"):
         fn(params, tokens, jax.random.PRNGKey(3))
+
+
+def test_gpt_context_parallel_position_table_guard():
+    """An undersized position table must raise, not silently clamp."""
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import gpt_loss
+
+    mesh = _mesh()
+    cfg = GPTConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4, vocab_size=64,
+        max_position_embeddings=S // 2,  # global seq S won't fit
+        hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, context_parallel_axis="cp",
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+    fn = shard_map(
+        lambda p, t: gpt_loss(cfg, p, t, t),
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P(None, "cp")),
+        out_specs=P(),
+    )
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        fn(params, tokens)
+
+
+def test_gpt_context_parallel_tileability_guard():
+    """Non-kernel-tileable head dim must fail loudly on every backend."""
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import gpt_loss
+
+    mesh = _mesh()
+    cfg = GPTConfig(
+        num_layers=1, hidden_size=576, num_attention_heads=2,  # hn=288>256
+        vocab_size=64, max_position_embeddings=S,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        apply_query_key_layer_scaling=False, context_parallel_axis="cp",
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+    fn = shard_map(
+        lambda p, t: gpt_loss(cfg, p, t, t),
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P(None, "cp")),
+        out_specs=P(),
+    )
+    with pytest.raises(ValueError, match="tileable"):
+        fn(params, tokens)
