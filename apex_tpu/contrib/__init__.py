@@ -11,7 +11,7 @@ Python wrappers). Subpackages, mirroring the reference's layout:
 - ``contrib.group_norm`` — NHWC GroupNorm (+swish)
 - ``contrib.focal_loss`` — fused focal loss
 - ``contrib.index_mul_2d`` — indexed elementwise multiply
-- ``contrib.sparsity`` — ASP 2:4 structured sparsity
+- ``contrib.sparsity`` — ASP 2:4 structured sparsity + channel-permutation search
 - ``contrib.bottleneck`` — (spatial-parallel) ResNet bottleneck + the
   ppermute halo exchangers (``HaloExchanger{NoComm,AllGather,SendRecv,Peer}``)
 - ``contrib.gpu_direct_storage`` — ``GDSFile`` raw tensor<->file IO

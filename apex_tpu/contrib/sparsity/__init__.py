@@ -1,4 +1,19 @@
 from .asp import ASP
+from .permutation_search import (
+    apply_2_to_4,
+    apply_permutation_C,
+    apply_permutation_K,
+    channel_swap_search,
+    sum_after_2_to_4,
+)
 from .sparse_masklib import create_mask
 
-__all__ = ["ASP", "create_mask"]
+__all__ = [
+    "ASP",
+    "create_mask",
+    "channel_swap_search",
+    "apply_2_to_4",
+    "sum_after_2_to_4",
+    "apply_permutation_C",
+    "apply_permutation_K",
+]

@@ -69,8 +69,11 @@ class ASP:
     ):
         if allow_permutation:
             raise NotImplementedError(
-                "channel-permutation search (permutation_lib) is an offline "
-                "tool not ported to TPU; pass allow_permutation=False"
+                "automatic graph-wide permutation (the reference's torch.fx "
+                "permutation_lib pass) has no jaxpr analogue; run "
+                "contrib.sparsity.channel_swap_search offline and apply the "
+                "permutation with apply_permutation_C/K, then use ASP with "
+                "allow_permutation=False"
             )
         if isinstance(mask_calculator, str):
             pattern = mask_calculator

@@ -98,6 +98,17 @@ def _sds(shape, dtype, *inputs):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def require_kernel_tileable(s: int, d: int, context: str) -> None:
+    """Raise the loud every-backend ValueError for shapes the Pallas
+    kernels cannot tile (seq % 8, head dim <= 256) — shared by every
+    caller that force-enables the kernels so the rule lives in one place."""
+    if s % 8 != 0 or d > 256:
+        raise ValueError(
+            f"{context} needs kernel-tileable shapes "
+            f"(seq {s} % 8 == 0 and head dim {d} <= 256)"
+        )
+
+
 def flash_attention_available(
     s_q: int, s_k: int, d: int, interpret: bool = False
 ) -> bool:

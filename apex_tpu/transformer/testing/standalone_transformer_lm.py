@@ -259,13 +259,21 @@ def parallel_attention(
                 "context parallelism: ring attention runs the flash chunk "
                 "kernels internally"
             )
-        if s % 8 != 0 or hn > 256:
-            # same loud every-backend gate as the forced-flash path: the
-            # ring path compiles the Pallas chunk kernels on TPU
-            raise ValueError(
-                f"context parallelism needs kernel-tileable shapes (local "
-                f"seq {s} % 8 == 0 and head dim {hn} <= 256)"
-            )
+        # same loud every-backend gate as the forced-flash path: the ring
+        # path compiles the Pallas chunk kernels on TPU. Zigzag runs them
+        # on HALF chunks, so the local length must split into two tileable
+        # halves.
+        from ...ops.flash_attention import require_kernel_tileable
+
+        if cfg.context_parallel_zigzag:
+            if s % 16 != 0:
+                raise ValueError(
+                    f"zigzag context parallelism needs local seq {s} % 16 "
+                    "== 0 (the kernels run on tileable half-chunks)"
+                )
+            require_kernel_tileable(s // 2, hn, "context parallelism")
+        else:
+            require_kernel_tileable(s, hn, "context parallelism")
         qb = jnp.transpose(q, (1, 2, 0, 3))   # [s,b,np,hn] -> [b,np,s,hn]
         kb = jnp.transpose(kk, (1, 2, 0, 3))
         vb = jnp.transpose(vv, (1, 2, 0, 3))
@@ -311,14 +319,12 @@ def parallel_attention(
                 "flash-compatible (traced qk scaling or a non-causal/"
                 "non-padding mask)"
             )
-        if s % 8 != 0 or hn > 256:
-            # the TPU-tileability rule of flash_attention_available, checked
-            # on every backend so a forced-on config fails loudly in CPU
-            # tests rather than at TPU compile time
-            raise ValueError(
-                f"use_flash_attention=True but the shapes are not kernel-"
-                f"tileable (seq {s} % 8 != 0 or head dim {hn} > 256)"
-            )
+        # the TPU-tileability rule of flash_attention_available, checked
+        # on every backend so a forced-on config fails loudly in CPU
+        # tests rather than at TPU compile time
+        from ...ops.flash_attention import require_kernel_tileable
+
+        require_kernel_tileable(s, hn, "use_flash_attention=True")
         use_flash = True
     else:
         use_flash = False
@@ -563,16 +569,17 @@ def _local_position_ids(cfg: GPTConfig, s_loc: int) -> jax.Array:
     """[s_loc] GLOBAL position ids of this rank's tokens. Without context
     parallelism that is just arange; under CP the shard's global offset
     (contiguous: rank*s_loc; zigzag: rank's two chunks r and 2cp-1-r)."""
-    if cfg.context_parallel_axis is None:
-        return jnp.arange(s_loc)
-    cp_size = jax.lax.axis_size(cfg.context_parallel_axis)
+    cp_size = (1 if cfg.context_parallel_axis is None
+               else jax.lax.axis_size(cfg.context_parallel_axis))
     if cp_size * s_loc > cfg.max_position_embeddings:
-        # jnp.take would clamp out-of-range ids silently — every token on
-        # later ranks would share the table's last row
+        # jnp.take would clamp out-of-range ids silently — late tokens
+        # would all share the table's last row (on EVERY path, not just CP)
         raise ValueError(
             f"global sequence {cp_size}*{s_loc}={cp_size * s_loc} exceeds "
             f"max_position_embeddings={cfg.max_position_embeddings}"
         )
+    if cfg.context_parallel_axis is None:
+        return jnp.arange(s_loc)
     r = jax.lax.axis_index(cfg.context_parallel_axis)
     if cfg.context_parallel_zigzag:
         if s_loc % 2 != 0:

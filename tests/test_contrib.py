@@ -365,3 +365,70 @@ def test_lm_head_cross_entropy_matches_unfused():
 
     with pytest.raises(ValueError, match="divisible"):
         lm_head_cross_entropy(hid, w, labels, chunk_size=24)
+
+
+# ---------------------------------------------------------------------------
+# sparsity: channel-permutation search (permutation_search_kernels)
+# ---------------------------------------------------------------------------
+
+
+def test_apply_2_to_4_structure_and_kept_sum():
+    from apex_tpu.contrib.sparsity import apply_2_to_4, sum_after_2_to_4
+
+    m = jax.random.normal(jax.random.PRNGKey(0), (16, 12))
+    pruned = apply_2_to_4(m)
+    groups = np.asarray(pruned).reshape(16, 3, 4)
+    assert ((groups != 0).sum(-1) <= 2).all()
+    # kept sum equals the brute-force top-2 magnitude per group
+    a = np.abs(np.asarray(m)).reshape(16, 3, 4)
+    top2 = np.sort(a, axis=-1)[..., 2:].sum()
+    assert abs(float(sum_after_2_to_4(m)) - top2) < 1e-4
+    with pytest.raises(ValueError, match="multiple of 4"):
+        apply_2_to_4(jnp.zeros((4, 6)))
+
+
+def test_channel_swap_search_improves_and_is_valid():
+    from apex_tpu.contrib.sparsity import (
+        channel_swap_search,
+        sum_after_2_to_4,
+    )
+
+    m = jax.random.normal(jax.random.PRNGKey(1), (24, 16))
+    base = float(sum_after_2_to_4(m))
+    perm, kept = channel_swap_search(np.asarray(m), max_iters=100)
+    assert sorted(perm.tolist()) == list(range(16))
+    permuted_kept = float(sum_after_2_to_4(m[:, perm]))
+    assert abs(permuted_kept - kept) < 1e-3
+    assert permuted_kept >= base - 1e-5  # never worse than identity
+
+
+def test_channel_swap_search_escape_needs_key():
+    from apex_tpu.contrib.sparsity import channel_swap_search
+
+    m = np.random.default_rng(2).standard_normal((8, 8)).astype(np.float32)
+    with pytest.raises(ValueError, match="requires key"):
+        channel_swap_search(m, max_iters=50, escape_attempts=2)
+    perm, _ = channel_swap_search(
+        m, max_iters=50, escape_attempts=2, key=jax.random.PRNGKey(3)
+    )
+    assert sorted(perm.tolist()) == list(range(8))
+
+
+def test_permutation_C_K_pair_preserves_composition():
+    """Consumer-C + producer-K permutation leaves the composed network
+    function unchanged (the identity the reference's fx graph pass
+    maintains, permutation_lib.py apply_permutation_in_{C,K}_dim)."""
+    from apex_tpu.contrib.sparsity import (
+        apply_permutation_C,
+        apply_permutation_K,
+        channel_swap_search,
+    )
+
+    rng = np.random.default_rng(4)
+    W1 = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)  # producer
+    W2 = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)  # consumer
+    x = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    perm, _ = channel_swap_search(np.asarray(W2), max_iters=50)
+    y = W2 @ (W1 @ x)
+    y_perm = apply_permutation_C(W2, perm) @ (apply_permutation_K(W1, perm) @ x)
+    assert jnp.abs(y - y_perm).max() < 1e-4
