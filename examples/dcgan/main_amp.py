@@ -1,0 +1,172 @@
+"""DCGAN with amp — multiple models, multiple losses, one scaler each.
+
+Port of ``/root/reference/examples/dcgan/main_amp.py``: Generator +
+Discriminator trained adversarially with
+``amp.initialize([netD, netG], [optD, optG], num_losses=3)`` (``:214``) —
+the reference takes three separately-scaled backwards per iteration
+(D-real ``loss_id=0``, D-fake ``loss_id=1``, G ``loss_id=2``) and this
+port keeps exactly that structure with three ``LossScaler`` states; the
+two D backwards produce unscaled grads that are summed, the functional
+analogue of the reference's accumulated ``.backward()`` calls.
+
+Synthetic data stands in for the reference's fake/cifar10/lsun loaders
+(dataset download has no place in CI; the adversarial dynamics are the
+point).
+
+    python main_amp.py --steps 20                 # default device
+    python main_amp.py --cpu 1 --steps 5          # CPU smoke
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def parse():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", type=int, default=0)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--nz", type=int, default=100, help="latent dim")
+    p.add_argument("--ngf", type=int, default=32)
+    p.add_argument("--ndf", type=int, default=32)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--opt_level", default="O1")
+    return p.parse_args()
+
+
+def main():
+    args = parse()
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu}"
+        )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    nc, nz, ngf, ndf = 3, args.nz, args.ngf, args.ndf
+
+    class Generator(nn.Module):  # reference ``Generator`` (main_amp.py:164)
+        @nn.compact
+        def __call__(self, z):  # [b, nz] -> [b, s, s, nc] in (-1, 1)
+            s0 = args.image_size // 8
+            x = nn.Dense(s0 * s0 * ngf * 4)(z)
+            x = x.reshape(z.shape[0], s0, s0, ngf * 4)
+            for mult in (2, 1):
+                x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+                x = nn.ConvTranspose(ngf * mult, (4, 4), strides=(2, 2))(x)
+            x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+            x = nn.ConvTranspose(nc, (4, 4), strides=(2, 2))(x)
+            return jnp.tanh(x)
+
+    class Discriminator(nn.Module):  # reference ``Discriminator`` (:204)
+        @nn.compact
+        def __call__(self, x):  # [b, s, s, nc] -> [b] logits
+            for mult in (1, 2, 4):
+                x = nn.Conv(ndf * mult, (4, 4), strides=(2, 2))(x)
+                x = nn.leaky_relu(x, 0.2)
+            return nn.Dense(1)(x.reshape(x.shape[0], -1))[:, 0]
+
+    key = jax.random.PRNGKey(0)
+    kG, kD, kdata = jax.random.split(key, 3)
+    netG, netD = Generator(), Discriminator()
+    z0 = jnp.zeros((args.batch, nz))
+    x0 = jnp.zeros((args.batch, args.image_size, args.image_size, nc))
+    paramsG = netG.init(kG, z0)
+    paramsD = netD.init(kD, x0)
+
+    optD = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
+    optG = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
+    # [netD, netG], [optD, optG], num_losses=3 — reference main_amp.py:214
+    [paramsD, paramsG], [optD, optG], amp_state = amp.initialize(
+        [paramsD, paramsG], [optD, optG], opt_level=args.opt_level,
+        num_losses=3,
+    )
+    stateD, stateG = optD.init(paramsD), optG.init(paramsG)
+    scalers = [amp_state.scaler(i) for i in range(3)]
+    sstates = [amp_state.scaler_state(i) for i in range(3)]
+
+    def bce_logits(logits, target):
+        # BCEWithLogits, as the reference's nn.BCELoss over sigmoid outputs
+        return jnp.mean(
+            jnp.maximum(logits, 0) - logits * target
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        )
+
+    def d_real_loss(paramsD, real):
+        with amp_state.autocast():
+            out = netD.apply(paramsD, real)
+        return bce_logits(out.astype(jnp.float32), 1.0)
+
+    def d_fake_loss(paramsD, fake):
+        with amp_state.autocast():
+            out = netD.apply(paramsD, fake)
+        return bce_logits(out.astype(jnp.float32), 0.0)
+
+    def g_loss(paramsG, paramsD, z):
+        with amp_state.autocast():
+            out = netD.apply(paramsD, netG.apply(paramsG, z))
+        return bce_logits(out.astype(jnp.float32), 1.0)
+
+    grad_d_real = amp.scaled_value_and_grad(d_real_loss, scalers[0])
+    grad_d_fake = amp.scaled_value_and_grad(d_fake_loss, scalers[1])
+    grad_g = amp.scaled_value_and_grad(g_loss, scalers[2])
+
+    @jax.jit
+    def step(paramsD, paramsG, stateD, stateG, sstates, real, z):
+        s0, s1, s2 = sstates
+        # --- D: real + fake backwards, grads accumulated ----------------
+        errD_real, gDr, s0 = grad_d_real(s0, paramsD, real)
+        fake = netG.apply(paramsG, z)
+        errD_fake, gDf, s1 = grad_d_fake(
+            s1, paramsD, jax.lax.stop_gradient(fake)
+        )
+        gD = jax.tree_util.tree_map(lambda a, b: a + b, gDr, gDf)
+        found_d = jnp.logical_or(s0.found_inf, s1.found_inf)
+        newD, newSD = optD.step(gD, stateD, paramsD)
+        paramsD = amp.apply_updates_skip_on_overflow(paramsD, newD, found_d)
+        stateD = amp.apply_updates_skip_on_overflow(stateD, newSD, found_d)
+        # --- G ----------------------------------------------------------
+        errG, gG, s2 = grad_g(s2, paramsG, paramsD, z)
+        newG, newSG = optG.step(gG, stateG, paramsG)
+        paramsG = amp.apply_updates_skip_on_overflow(
+            paramsG, newG, s2.found_inf)
+        stateG = amp.apply_updates_skip_on_overflow(
+            stateG, newSG, s2.found_inf)
+        sstates = (scalers[0].update_scale(s0), scalers[1].update_scale(s1),
+                   scalers[2].update_scale(s2))
+        return (paramsD, paramsG, stateD, stateG, sstates,
+                errD_real + errD_fake, errG)
+
+    for it in range(args.steps):
+        kdata, kx, kz = jax.random.split(kdata, 3)
+        real = jax.random.uniform(
+            kx, (args.batch, args.image_size, args.image_size, nc),
+            minval=-1.0, maxval=1.0,
+        )
+        z = jax.random.normal(kz, (args.batch, nz))
+        (paramsD, paramsG, stateD, stateG, sstates, errD, errG) = step(
+            paramsD, paramsG, stateD, stateG, tuple(sstates), real, z
+        )
+        if it % 5 == 0 or it == args.steps - 1:
+            print(f"[{it}/{args.steps}] Loss_D {float(errD):.4f} "
+                  f"Loss_G {float(errG):.4f}")
+    assert np.isfinite(float(errD)) and np.isfinite(float(errG))
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

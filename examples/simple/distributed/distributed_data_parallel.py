@@ -1,0 +1,115 @@
+"""Minimal amp + DistributedDataParallel example.
+
+Port of ``/root/reference/examples/simple/distributed/
+distributed_data_parallel.py``: a single linear layer trained on fake
+data with ``amp.initialize(opt_level="O1")`` and apex DDP. The launcher
+machinery changes shape — ``torch.distributed.launch`` + per-process
+``local_rank`` + NCCL init becomes ONE process owning a ``data`` mesh
+axis (SPMD; ``run.sh`` there is `python distributed_data_parallel.py`
+here), and ``DistributedDataParallel(model)`` becomes the grad-sync
+transform applied inside the step.
+
+    python distributed_data_parallel.py              # all local devices
+    python distributed_data_parallel.py --cpu 8      # 8-virtual-CPU mesh
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu", type=int, default=0,
+                   help="force a CPU mesh with this many virtual devices")
+    p.add_argument("--steps", type=int, default=500)
+    args = p.parse_args()
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu}"
+        )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import DistributedDataParallel
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    world = len(devices)
+    print(f"world size {world} ({devices[0].device_kind})")
+
+    N, D_in, D_out = 64, 1024, 16
+    key = jax.random.PRNGKey(0)
+    kx, ky, kw = jax.random.split(key, 3)
+    # each data shard is this rank's "fake batch", as in the reference
+    x = jax.random.normal(kx, (N * world, D_in))
+    y = jax.random.normal(ky, (N * world, D_out))
+    params = {
+        "w": jax.random.normal(kw, (D_in, D_out)) * 0.01,
+        "b": jnp.zeros((D_out,)),
+    }
+
+    opt = FusedSGD(lr=1e-3)
+    params, opt, amp_state = amp.initialize(params, opt, opt_level="O1")
+    opt_state = opt.init(params)
+    scaler = amp_state.scaler(0)
+    scaler_state = amp_state.scaler_state(0)
+
+    ddp = DistributedDataParallel(axis_name="data")
+
+    def loss_fn(params, x, y):
+        with amp_state.autocast():
+            pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    grad_fn = amp.scaled_value_and_grad(loss_fn, scaler)
+
+    def local_step(params, opt_state, scaler_state, x, y):
+        loss, grads, scaler_state = grad_fn(scaler_state, params, x, y)
+        grads = ddp.sync(grads)  # bucketed psum over the data axis
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        params = amp.apply_updates_skip_on_overflow(
+            params, new_params, scaler_state.found_inf
+        )
+        opt_state = amp.apply_updates_skip_on_overflow(
+            opt_state, new_opt_state, scaler_state.found_inf
+        )
+        scaler_state = scaler.update_scale(scaler_state)
+        return params, opt_state, scaler_state, jax.lax.pmean(loss, "data")
+
+    pspec = jax.tree_util.tree_map(lambda _: P(), params)
+    ospec = jax.tree_util.tree_map(lambda _: P(), opt_state)
+    sspec = jax.tree_util.tree_map(lambda _: P(), scaler_state)
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspec, ospec, sspec, P("data"), P("data")),
+        out_specs=(pspec, ospec, sspec, P()),
+        check_vma=True,
+    ))
+    x = jax.device_put(x, NamedSharding(mesh, P("data")))
+    y = jax.device_put(y, NamedSharding(mesh, P("data")))
+
+    for t in range(args.steps):
+        params, opt_state, scaler_state, loss = step(
+            params, opt_state, scaler_state, x, y
+        )
+        # block per step: keeps the async dispatch queue shallow so the
+        # CPU-mesh collective rendezvous can't starve on small hosts
+        jax.block_until_ready(loss)
+        if t % 100 == 0 or t == args.steps - 1:
+            print(f"step {t}: loss {float(loss):.6f}")
+    assert np.isfinite(float(loss))
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

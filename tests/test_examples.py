@@ -7,6 +7,8 @@ behaves. This is the composition test of amp + DDP + SyncBN + fused
 optimizers that no unit test covers.
 """
 import os
+import re
+import subprocess
 import sys
 
 import jax
@@ -129,3 +131,56 @@ def test_syncbn_resnet_stats_replicated_across_mesh():
         shards = [np.asarray(s.data) for s in leaf.addressable_shards]
         for s in shards[1:]:
             np.testing.assert_array_equal(shards[0], s)
+
+
+# ---------------------------------------------------------------------------
+# examples/simple/distributed + examples/dcgan (+ examples/long_context)
+# ---------------------------------------------------------------------------
+
+
+def _run_example(rel, argv):
+    # run in a SUBPROCESS (the reference's example tests are also
+    # subprocess-driven): isolates each example's jax/XLA state from the
+    # in-process tests above and from each other
+    path = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "examples", *rel)
+    ) + ".py"
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, path] + argv, capture_output=True, text=True,
+        env=env, timeout=900, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+def test_simple_distributed_example():
+    """Reference examples/simple/distributed: amp O1 + DDP on the mesh."""
+    out = _run_example(("simple", "distributed",
+                        "distributed_data_parallel"),
+                       ["--cpu", "8", "--steps", "60"])
+    assert "world size 8" in out and "done." in out
+    # loss decreased over training
+    losses = [float(m) for m in re.findall(r"loss ([0-9.]+)", out)]
+    assert losses[-1] < losses[0]
+
+
+def test_dcgan_example():
+    """Reference examples/dcgan: two models, three scaled losses."""
+    out = _run_example(("dcgan", "main_amp"),
+                 ["--cpu", "1", "--steps", "3", "--batch", "8",
+                  "--image-size", "16", "--ngf", "8", "--ndf", "8"])
+    assert "Loss_D" in out and "done." in out
+
+
+def test_long_context_example():
+    """examples/long_context: end-to-end CP training decreases the loss."""
+    out = _run_example(("long_context", "train_long_context"),
+                 ["--cpu", "8", "--seq", "512", "--steps", "3",
+                  "--layers", "2", "--hidden", "64", "--heads", "4",
+                  "--vocab", "128"])
+    assert "done." in out
+    losses = [float(m) for m in re.findall(r"loss ([0-9.]+)", out)]
+    assert losses[-1] < losses[0]
