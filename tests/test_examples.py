@@ -184,3 +184,15 @@ def test_long_context_example():
     assert "done." in out
     losses = [float(m) for m in re.findall(r"loss ([0-9.]+)", out)]
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O3"])
+def test_imagenet_opt_level_cross_product(monkeypatch, tmp_path, capsys,
+                                          opt_level):
+    """The reference L1 harness runs the imagenet example across O0-O3
+    (tests/L1/common/run_test.sh:19-29); O2 is covered by the config
+    tests above — this sweeps the remaining levels."""
+    prec1 = _run_main(monkeypatch, tmp_path, ["--opt-level", opt_level])
+    out = capsys.readouterr().out
+    assert "Epoch: [0][2/3]" in out
+    assert np.isfinite(prec1)
