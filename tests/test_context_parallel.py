@@ -415,11 +415,10 @@ def test_zigzag_ring_other_axis_sizes(cp):
     q, k, v = _qkv(10)
     perm, inv = zigzag_indices(S, cp)
     mesh = Mesh(np.array(jax.devices()[:cp]), ("cp",))
-    spec = P(None, None, "cp", None)
-    fn = shard_map(
+    fn = _sharded(
         functools.partial(ring_attention, axis_name="cp", causal=True,
                           zigzag=True, block_q=8, block_k=8),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        mesh,
     )
     out = fn(_zig(q, perm), _zig(k, perm), _zig(v, perm))[:, :, inv, :]
     ref = mha_reference(q, k, v, causal=True)
