@@ -331,10 +331,12 @@ def test_all_public_names_import():
                  *apex_tpu._LAZY_SUBMODULES):
         assert getattr(apex_tpu, name) is not None
     contrib = importlib.import_module("apex_tpu.contrib")
-    for sub in ["optimizers", "clip_grad", "focal_loss", "group_norm",
-                "index_mul_2d", "layer_norm", "sparsity", "xentropy"]:
-        importlib.import_module(f"apex_tpu.contrib.{sub}")
-    del contrib
+    # EVERY contrib subpackage must import (round-2 regression: a stub
+    # __init__ made `import apex_tpu.contrib` itself raise)
+    for sub in ("optimizers",) + contrib._LAZY:
+        mod = importlib.import_module(f"apex_tpu.contrib.{sub}")
+        for name in getattr(mod, "__all__", ()):
+            assert hasattr(mod, name), f"contrib.{sub}.{name}"
 
 
 def test_lm_head_cross_entropy_matches_unfused():
