@@ -100,6 +100,15 @@ def attention_core(
     typically the AlphaFold ``[b, 1, 1, k]`` key mask; ``bias`` is an
     additive logit bias broadcastable to ``[b, h, q, k]``. Differentiable
     in q/k/v/bias (like the reference, which returns dB but no dmask).
+
+    Divergence from the reference for fully-masked rows: a key-only mask
+    rides the kernel's ``kv_mask`` input, which excludes masked keys
+    exactly — a row whose keys are ALL masked yields zeros. The reference
+    instead adds a finite ``(mask - 1) * inf`` penalty, so such a row
+    softmaxes to a uniform average over all values. Rows with at least one
+    live key agree to kernel tolerance; AlphaFold-style callers that rely
+    on the uniform-average behavior for fully-padded rows should pass the
+    mask folded into ``bias`` instead.
     """
     del is_training  # dropout-free core, as in the reference kernel
     q, had5 = _to_bnsd(q)
@@ -125,7 +134,10 @@ def attention_core(
             # size-1 batch/head/q dims itself
             if m.shape[-1] != s_k:
                 m = jnp.broadcast_to(m, m.shape[:3] + (s_k,))
-            mask_bias = (m - 1.0) * inf
+            # the reference returns no dmask: keep the folded mask out of
+            # the autodiff graph so d(add_bias)/d(mask) inf-scaled terms
+            # can't leak when a learned bias is also present
+            mask_bias = jax.lax.stop_gradient((m - 1.0) * inf)
     add_bias = mask_bias
     if bias is not None:
         bias = _drop5(bias, "bias")

@@ -118,7 +118,16 @@ def write_arrays(
 
         if isinstance(path, int):
             for a, off in zip(host, offsets):
-                os.pwrite(path, a.tobytes(), off)
+                buf = memoryview(a.tobytes())
+                # pwrite may write fewer bytes than asked (signals, some
+                # filesystems) — loop to completion like full_pwrite in
+                # hostio.cpp
+                written = 0
+                while written < len(buf):
+                    n = os.pwrite(path, buf[written:], off + written)
+                    if n <= 0:
+                        raise OSError(f"pwrite returned {n} at {off + written}")
+                    written += n
         else:
             with open(path, "r+b" if _exists(path) else "wb") as f:
                 for a, off in zip(host, offsets):
@@ -165,9 +174,21 @@ def read_arrays(
                 raise EOFError(f"expected {a.nbytes} bytes at {off}")
             a[...] = np.frombuffer(buf, dtype=a.dtype).reshape(a.shape)
 
+        def _pread_full(fd, nbytes, off):
+            # like full_pread in hostio.cpp: loop past short reads, stop
+            # at true EOF (pread returning 0)
+            chunks, got = [], 0
+            while got < nbytes:
+                c = os.pread(fd, nbytes - got, off + got)
+                if not c:
+                    break
+                chunks.append(c)
+                got += len(c)
+            return b"".join(chunks)
+
         if isinstance(path, int):
             for a, off in zip(outs, offsets):
-                _fill(a, os.pread(path, a.nbytes, off), off)
+                _fill(a, _pread_full(path, a.nbytes, off), off)
         else:
             with open(path, "rb") as f:
                 for a, off in zip(outs, offsets):
@@ -218,6 +239,15 @@ def unflatten(
             shape, dtype = t.shape, t.dtype
         outs.append(np.empty(shape, dtype=dtype))
     _check_counts(offsets, len(outs), "unflatten")
+    # the native engine memcpys with no bounds info — fail loudly on bad
+    # offsets here so both paths behave like the Python fallback would
+    for a, off in zip(outs, offsets):
+        off = int(off)
+        if off < 0 or off + a.nbytes > arena.nbytes:
+            raise ValueError(
+                f"unflatten: slice [{off}, {off + a.nbytes}) out of bounds "
+                f"for arena of {arena.nbytes} bytes"
+            )
     lib = load_hostio()
     if lib is not None:
         rc = lib.hostio_unpack(

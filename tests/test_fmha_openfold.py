@@ -194,3 +194,25 @@ def test_flash_bias_grad_false_returns_zeros():
         qq, k, v, bias=bias, bias_grad=False, block_q=16, block_k=16) ** 2)
     )(q)
     assert jnp.abs(dq).max() > 0.0
+
+
+def test_openfold_mask_grad_finite_with_bias():
+    """A general (non key-only) {0,1} mask folded to (mask-1)*inf must not
+    leak inf-scaled terms into autodiff when a learned bias is present
+    (stop_gradient on the folded mask; the reference returns no dmask)."""
+    key = jax.random.PRNGKey(9)
+    ks = jax.random.split(key, 5)
+    b, h, n, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, d)) for i in range(3))
+    bias = jax.random.normal(ks[3], (1, h, n, n)) * 0.5
+    # per-(q,k) mask -> additive-fold path, with some masked entries
+    mask = jax.random.bernoulli(ks[4], 0.8, (b, 1, n, n)).astype(jnp.float32)
+    mask = mask.at[..., 0].set(1.0)  # keep every row alive
+
+    def loss(m, bb):
+        return jnp.sum(attention_core(q, k, v, mask=m, bias=bb) ** 2)
+
+    dm, db = jax.grad(loss, argnums=(0, 1))(mask, bias)
+    assert jnp.all(jnp.isfinite(db))
+    # folded mask carries no gradient at all
+    assert jnp.abs(dm).max() == 0.0

@@ -180,3 +180,11 @@ def test_unflatten_noncontiguous_arena():
     wide[:, 0] = arena_f32
     (back,) = hostio.unflatten(wide[:, 0], [a], offs)
     np.testing.assert_array_equal(a, back)
+
+def test_unflatten_out_of_bounds_offset_raises():
+    a = np.arange(64, dtype=np.float32)
+    arena, offs = hostio.flatten([a])
+    with pytest.raises(ValueError, match="out of bounds"):
+        hostio.unflatten(arena, [a], [arena.nbytes - 4])
+    with pytest.raises(ValueError, match="out of bounds"):
+        hostio.unflatten(arena, [a], [-8])
