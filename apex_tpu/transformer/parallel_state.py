@@ -281,18 +281,32 @@ def get_pipeline_model_parallel_prev_rank():
 
 # --- embedding groups (reference :319-407,:466-486) --------------------------
 # In the reference, first and last pipeline stages form an "embedding group"
-# for tying input/output embeddings; the grad sync is an all-reduce between
-# those two stage ranks. On a mesh this is a predicate + masked psum over the
-# pipeline axis (see pipeline_parallel.utils.sync_embedding_grads).
+# for tying input/output embeddings (plus the split stage for
+# encoder-decoder models); the grad sync is an all-reduce between those
+# stage ranks. On a mesh this is a predicate + masked psum over the pipeline
+# axis — implemented by ``pipeline_parallel.utils.sync_embedding_grads`` /
+# ``sync_position_embedding_grads``.
 
 def is_rank_in_embedding_group(ignore_virtual: bool = False):
-    return is_pipeline_first_stage(ignore_virtual) | is_pipeline_last_stage(
+    """Reference ``:352-367,:466-476``: ranks [first, last] plus the
+    pipeline split rank when one is set (encoder-decoder tying)."""
+    in_group = is_pipeline_first_stage(ignore_virtual) | is_pipeline_last_stage(
         ignore_virtual
     )
+    split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    if split is not None:
+        in_group = in_group | (get_pipeline_model_parallel_rank() == split)
+    return in_group
 
 
 def is_rank_in_position_embedding_group():
-    return is_pipeline_first_stage(ignore_virtual=True)
+    """Reference ``:354,:369-375,:479-486``: rank 0 plus the pipeline split
+    rank when one is set."""
+    in_group = is_pipeline_first_stage(ignore_virtual=True)
+    split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    if split is not None:
+        in_group = in_group | (get_pipeline_model_parallel_rank() == split)
+    return in_group
 
 
 # --- misc sizes --------------------------------------------------------------

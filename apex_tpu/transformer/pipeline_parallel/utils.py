@@ -281,13 +281,25 @@ def pvary_full(tree: Pytree, axis_names: Sequence[str]) -> Pytree:
     """Mark every leaf of ``tree`` as varying over all of ``axis_names``.
 
     The composed-mesh (TP x PP x DP) entry pattern under
-    ``shard_map(check_vma=True)``: marking every operand fully varying makes
-    autodiff produce pure per-device partial gradients with no implicit
-    collectives, so the cross-device gradient structure can be applied
-    explicitly (and auditable) by :func:`sync_grads_by_spec`. This is the
-    library spelling of the grad-sync contract the reference distributes
-    across DDP hooks (``apex/parallel/distributed.py:323-412``) and the TP
-    linears' backward all-reduces (``tensor_parallel/layers.py:279-437``).
+    ``shard_map(check_vma=True)``. GRADIENT CONTRACT — the transpose of
+    ``pvary`` is a **psum over the axes it added**, so there are two
+    regimes (pinned by ``tests/test_composed_parallelism.py`` and
+    ``tests/test_tied_embedding_pipeline.py``):
+
+    - ``value_and_grad`` of a function that calls ``pvary_full`` on its
+      own inputs differentiates the PRE-pvary values: grads come back
+      FULLY SYNCED (replicated-axis cotangents psummed, sharded axes kept
+      per-shard). Do NOT re-psum them — :func:`sync_grads_by_spec` on top
+      double-counts.
+    - differentiating w.r.t. ALREADY-pvary'd values (e.g. the stage
+      params inside ``pipeline_forward_backward``) skips that transpose:
+      grads are per-shard partials on the replicated axes and need
+      :func:`sync_grads_by_spec`.
+
+    Together these are the library spelling of the grad-sync contract the
+    reference distributes across DDP hooks
+    (``apex/parallel/distributed.py:323-412``) and the TP linears'
+    backward all-reduces (``tensor_parallel/layers.py:279-437``).
     """
     def leaf(x):
         missing = tuple(
@@ -307,7 +319,13 @@ def sync_grads_by_spec(grads: Pytree, pspec: Pytree, axis_names: Sequence[str]) 
     per-shard gradients (no sync); a parameter replicated over an axis
     accumulated per-device partials there that must be summed — data-parallel
     sync over ``data``, replicated-weight sync over ``tensor``/``pipeline``.
-    Use with :func:`pvary_full` on the inputs of the gradient computation.
+
+    ONLY for grads that really are per-device partials: grads taken w.r.t.
+    already-pvary'd values (``pipeline_forward_backward``'s stage params)
+    or produced under ``check_vma=False``. Grads from ``value_and_grad``
+    of a function that pvary's its own inputs are already synced by the
+    pvary transpose — syncing them again double-counts (see
+    :func:`pvary_full`).
     """
 
     def sync(g, spec):
@@ -325,20 +343,76 @@ def sync_grads_by_spec(grads: Pytree, pspec: Pytree, axis_names: Sequence[str]) 
     return jax.tree_util.tree_map(sync, grads, pspec)
 
 
+def sync_embedding_grads(grads: Pytree, axis_name: Optional[str] = None) -> Pytree:
+    """All-reduce tied-embedding grads over the pipeline embedding group.
+
+    Reference: Megatron-style trainers all-reduce the word-embedding grad
+    between the first and last pipeline stages, which both hold a copy of
+    the tied table (the ``_EMBEDDING_GROUP`` built at
+    ``apex/transformer/parallel_state.py:319-407``; the predicate surface at
+    ``:466-476``). On a mesh the "group" is a masked psum over the pipeline
+    axis: contributions from stages outside the embedding group (first,
+    last, and the split stage for encoder-decoder models) are zeroed, then
+    summed, so every stage leaves with the combined input-embedding +
+    LM-head gradient. Stages outside the group receive the synced value too
+    — harmless for a replicated parameter, and required in SPMD where every
+    device runs the same program.
+
+    Use when the tied table is REPLICATED over the pipeline axis AND the
+    grads are per-stage partials — a manual/``check_vma=False`` flow, or a
+    custom-vjp schedule that assembles stage grads itself (the reference's
+    per-rank ``weight.grad`` state). Under ``check_vma=True`` autodiff of
+    a function that pvary's its inputs, the pipeline sum already happened
+    in the pvary transpose (see :func:`pvary_full`) — though without the
+    group masking this utility adds. When the table is vocab-sharded over
+    the pipeline axis instead (the memory-lean layout — see
+    ``__graft_entry__``), each stage owns distinct rows and no pipeline
+    sync applies at all.
+    """
+    return _group_masked_psum(
+        grads, parallel_state.is_rank_in_embedding_group(), axis_name
+    )
+
+
+def sync_position_embedding_grads(
+    grads: Pytree, axis_name: Optional[str] = None
+) -> Pytree:
+    """All-reduce position-embedding grads over the position-embedding
+    group (reference ranks [0] + split stage, ``parallel_state.py:354,
+    :369-375``) — the encoder-decoder analogue of
+    :func:`sync_embedding_grads` for the (untied) position table."""
+    return _group_masked_psum(
+        grads, parallel_state.is_rank_in_position_embedding_group(), axis_name
+    )
+
+
+def _group_masked_psum(grads: Pytree, in_group, axis_name: Optional[str]) -> Pytree:
+    """Masked all-reduce over the pipeline axis: contributions from ranks
+    outside ``in_group`` are zeroed, then summed (the mesh spelling of a
+    reference sub-group all-reduce)."""
+    a = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
+
+    def sync(g):
+        masked = jnp.where(in_group, g, jnp.zeros_like(g))
+        return jax.lax.psum(masked, a)
+
+    return jax.tree_util.tree_map(sync, grads)
+
+
 def mask_to_axis_root(value: jax.Array, axis_names) -> jax.Array:
     """Zero ``value`` on every rank except index 0 of each axis in
     ``axis_names``.
 
     Companion to :func:`pvary_full`/:func:`sync_grads_by_spec`: a loss that
-    is *replicated* over an axis (e.g. tensor-parallel ranks after an output
-    gather, or vocab-parallel CE after its psums) must seed its cotangent
-    exactly once per replica group, otherwise the collective transposes in
-    the backward (psum / psum_scatter inside the TP mappings) sum the
-    duplicate seeds and every gradient comes out scaled by the axis size.
-    Mask the loss with this before differentiating, then undo the mask on
-    the *value* with ``jax.lax.psum(loss, axis)``. (The pipeline schedules
-    already apply the same masking over the pipeline axis — non-last stages
-    contribute zero.)
+    is replicated in VALUE but varying in TYPE over an axis (e.g. after an
+    ``all_gather`` of TP outputs) would seed one cotangent per replica,
+    scaling every gradient by the axis size. Mask the loss with this
+    before differentiating, then undo the mask on the *value* with
+    ``jax.lax.psum(loss, axis)``. A loss that is replicated-TYPED (built
+    through ``psum``/``pmean``, like the vocab-parallel CE) seeds exactly
+    once by the vma rules and needs no mask — masking + psum-undo is then
+    a harmless identity. (The pipeline schedules already apply the same
+    masking over the pipeline axis — non-last stages contribute zero.)
     """
     if isinstance(axis_names, str):
         axis_names = (axis_names,)

@@ -1,10 +1,23 @@
 """Composed TP x PP x DP gradients vs a dense single-device reference.
 
 This pins the exact math of the multi-chip entry (`__graft_entry__.py`'s
-`dryrun_multichip`) as a library-level test, per the pattern
-`pvary_full` + explicit `sync_grads_by_spec` under `check_vma=True` —
-the number-one place a silent wrong-gradient bug could hide when TP, PP
-and DP compose on one mesh.
+`dryrun_multichip`) as a library-level test — the number-one place a
+silent wrong-gradient bug could hide when TP, PP and DP compose on one
+mesh.
+
+Two gradient regimes exist under ``check_vma=True`` and this test pins
+the manual one:
+
+- differentiating AROUND the ``pvary_full`` (``value_and_grad`` of a
+  function that pvary's its own inputs, as ``__graft_entry__`` and
+  ``test_tied_embedding_pipeline`` do) returns FULLY-SYNCED grads — the
+  transpose of ``pvary`` is a psum over the axes it added; adding
+  ``sync_grads_by_spec`` on top double-counts;
+- differentiating w.r.t. ALREADY-pvary'd values (what
+  ``pipeline_forward_backward`` does internally with the stage params it
+  is handed) returns per-shard partials on the replicated axes, and
+  ``sync_grads_by_spec`` + the 1/DP mean normalisation are required —
+  this file's pattern.
 
 Model: PP pipeline stages, each stage a column-parallel linear (TP-sharded
 output dim, gathered) + tanh; batch sharded over the data axis; every
@@ -102,10 +115,10 @@ def test_tp_pp_dp_composed_gradients_match_dense(mesh3d):
         loss, grads, _ = pipeline_forward_backward(
             stage_fn, loss_fn, params, inputs, targets, axis_name=pl,
         )
-        # per-device partials -> the real collective structure, explicitly.
-        # The stage axis was stripped from the grads but the params ARE
-        # pipeline-sharded, so keep pl in the spec (sync reads axis names
-        # only): no psum over pipeline or tensor, psum over data.
+        # pipeline_forward_backward differentiates w.r.t. the already-
+        # pvary'd stage params it was handed, so its grads are per-shard
+        # PARTIALS on the replicated axes: sync them explicitly — psum over
+        # every axis the param spec does not shard (here: data only).
         grads = sync_grads_by_spec(grads, pspec, all_axes)
         # grads are sums over data shards of per-shard mean losses; the
         # dense reference means over the full batch -> divide by DP

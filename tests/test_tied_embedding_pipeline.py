@@ -1,0 +1,334 @@
+"""Tied input/output embeddings under pipeline parallelism vs dense.
+
+Two layouts, both pinned against a single-device dense reference:
+
+1. **Vocab-sharded over (pipeline, tensor)** — the `__graft_entry__`
+   layout: no stage stores the full table, the lookup/head are
+   vocab-parallel over the combined axes, and the tied gradient lands on
+   each owner's rows. Under ``check_vma=True`` the grads need NO manual
+   sync at all: the vma type system inserts the exact psums (replicated
+   inputs get their cotangents all-reduced; a replicated-typed loss seeds
+   its cotangent exactly once).
+2. **Replicated over pipeline** — the reference layout
+   (``apex/transformer/parallel_state.py:319-407``: first/last stage own a
+   copy of the tied table and all-reduce its grad over the embedding
+   group). Driven as a MANUAL flow (``check_vma=False``): autodiff then
+   leaves per-stage partial grads exactly like the reference's per-rank
+   ``.grad`` fields — input-side on the first stage, head-side on the
+   last — and ``sync_embedding_grads`` performs the embedding-group
+   all-reduce.
+
+Plus unit tests of the group masking itself (junk on non-group ranks must
+be dropped; split-rank groups must include the split stage).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    pipeline_rounds,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    pvary_full,
+    sync_embedding_grads,
+    sync_position_embedding_grads,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    column_parallel_linear,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+
+N_MICRO = 4
+H = 8
+V = 32
+S = 8
+
+
+def _make_params(key, pp):
+    ks = jax.random.split(key, 2 + pp)
+    return {
+        "word": jax.random.normal(ks[0], (V, H)) * 0.5,
+        "pos": jax.random.normal(ks[1], (S, H)) * 0.1,
+        "w": jnp.stack(
+            [jax.random.normal(k, (H, H)) * 0.5 for k in ks[2:]]
+        ),
+        "b": jnp.zeros((pp, H)),
+    }
+
+
+def _dense_loss(pp):
+    def loss(params, tokens, labels):
+        emb = jnp.take(params["word"], tokens, axis=0) + params["pos"][:S]
+        h = emb  # [n, b, s, h]
+        for st in range(pp):
+            h = jnp.tanh(
+                jnp.einsum("nbsh,oh->nbso", h, params["w"][st])
+                + params["b"][st]
+            )
+        logits = jnp.einsum("nbsh,vh->nbsv", h, params["word"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(ce)
+
+    return loss
+
+
+@pytest.mark.parametrize("pp,dp,tp", [(2, 2, 2), (4, 1, 2), (2, 1, 1)])
+def test_vocab_sharded_tied_embedding_matches_dense(pp, dp, tp):
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=tp, pipeline_model_parallel_size_=pp,
+        devices=jax.devices()[: pp * dp * tp],
+    )
+    try:
+        mesh = parallel_state.get_mesh()
+        pl, d, t = (
+            parallel_state.PIPELINE_AXIS,
+            parallel_state.DATA_AXIS,
+            parallel_state.TENSOR_AXIS,
+        )
+        all_axes = (pl, d, t)
+        mbs = 2 * dp
+        params = _make_params(jax.random.PRNGKey(0), pp)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (N_MICRO, mbs, S), 0, V
+        )
+        labels = jax.random.randint(
+            jax.random.PRNGKey(2), (N_MICRO, mbs, S), 0, V
+        )
+
+        pspec = {
+            "word": P((pl, t), None), "pos": P(),
+            "w": P(pl, t, None), "b": P(pl, t),
+        }
+        data_spec = P(None, d, None)
+
+        def stage_fn(lp, x):
+            y, _ = column_parallel_linear(
+                x, lp["w"], lp["b"], axis_name=t, gather_output=True
+            )
+            return jnp.tanh(y)
+
+        def local(params, tokens, labels):
+            stage_p = {"w": params["w"][0], "b": params["b"][0]}
+            params = pvary_full(params, all_axes)
+            stage_p = pvary_full(stage_p, all_axes)
+            tokens = pvary_full(tokens, all_axes)
+            labels = pvary_full(labels, all_axes)
+            pp_sz = jax.lax.axis_size(pl)
+            rank = jax.lax.axis_index(pl)
+
+            def embed_micro(tok):  # [b, s] -> [b, s, h]
+                word = vocab_parallel_embedding(
+                    tok, params["word"], axis_name=(pl, t)
+                )
+                return word + params["pos"][: tok.shape[-1]]
+
+            emb = jax.vmap(embed_micro)(tokens)  # [n, b, s, h]
+            outs = pipeline_rounds(stage_fn, (stage_p,), emb, pl, False)
+            # broadcast the last stage's output; every device then computes
+            # only its v/(pp*tp) logit shard
+            keep = (rank == pp_sz - 1) & (jax.lax.axis_index(t) == 0)
+            y = jax.lax.psum(
+                jnp.where(keep, outs, jnp.zeros_like(outs)), (pl, t)
+            )
+            logits = jnp.einsum("nbsh,vh->nbsv", y, params["word"])
+            n, b, s, vloc = logits.shape
+            losses = vocab_parallel_cross_entropy(
+                logits.reshape(n * b, s, vloc),
+                labels.reshape(n * b, s), 0.0, (pl, t),
+            )
+            # the CE's psums leave the loss replicated-TYPED over (pl, t):
+            # it seeds once; pmean over data closes the d axis. No masks,
+            # no manual grad sync — the vma transposes do the whole
+            # collective gradient structure.
+            return jax.lax.pmean(jnp.mean(losses), d)
+
+        loss, grads = jax.jit(
+            jax.shard_map(
+                lambda p, x, y: jax.value_and_grad(local)(p, x, y),
+                mesh=mesh,
+                in_specs=(pspec, data_spec, data_spec),
+                out_specs=(P(), pspec),
+                check_vma=True,
+            )
+        )(params, tokens, labels)
+
+        ref_loss, ref_grads = jax.value_and_grad(_dense_loss(pp))(
+            params, tokens, labels
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for k in ("word", "pos", "w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_grads[k]), atol=2e-5,
+                err_msg=f"grad {k}",
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_replicated_tied_embedding_sync_matches_dense():
+    """Reference layout as a MANUAL flow: tied table replicated over the
+    pipeline axis, per-stage partial grads (input-side on stage 0,
+    head-side on the last stage, zeros in the middle — the reference's
+    per-rank ``weight.grad`` state), combined by ``sync_embedding_grads``
+    exactly like the reference's embedding-group all-reduce."""
+    pp = 4
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=1, pipeline_model_parallel_size_=pp,
+        devices=jax.devices()[:pp],
+    )
+    try:
+        mesh = parallel_state.get_mesh()
+        pl = parallel_state.PIPELINE_AXIS
+        mbs = 2
+        params = _make_params(jax.random.PRNGKey(3), pp)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(4), (N_MICRO, mbs, S), 0, V
+        )
+        labels = jax.random.randint(
+            jax.random.PRNGKey(5), (N_MICRO, mbs, S), 0, V
+        )
+
+        pspec = {"word": P(), "pos": P(), "w": P(pl, None, None),
+                 "b": P(pl, None)}
+
+        def stage_fn(lp, x):
+            return jnp.tanh(jnp.einsum("...h,oh->...o", x, lp["w"]) + lp["b"])
+
+        def local(params, tokens, labels):
+            stage_p = {"w": params["w"][0], "b": params["b"][0]}
+            pp_sz = jax.lax.axis_size(pl)
+            rank = jax.lax.axis_index(pl)
+            # stage 0 embeds (other stages' results are dead inputs to the
+            # schedule, exactly like the reference where only stage 0 holds
+            # the embedding layer)
+            emb = (
+                jnp.take(params["word"], tokens, axis=0)
+                + params["pos"][: tokens.shape[-1]]
+            )
+            outs = pipeline_rounds(stage_fn, (stage_p,), emb, pl, False)
+            # the LAST stage computes the full tied head (reference layout)
+            logits = jnp.einsum("nbsh,vh->nbsv", outs, params["word"])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ce = -jnp.take_along_axis(
+                logp, labels[..., None], axis=-1
+            )[..., 0]
+            return jnp.where(rank == pp_sz - 1, jnp.mean(ce), 0.0)
+
+        def grads_fn(params, tokens, labels):
+            loss, grads = jax.value_and_grad(local)(params, tokens, labels)
+            # manual flow: grads are per-stage partials. Tied table: the
+            # embedding-group all-reduce; position table: the
+            # position-group all-reduce; stage params are pipeline-sharded
+            # (no sync).
+            word = sync_embedding_grads(grads["word"])
+            pos = sync_position_embedding_grads(grads["pos"])
+            loss = jax.lax.psum(loss, pl)
+            return loss, {
+                "word": word, "pos": pos, "w": grads["w"], "b": grads["b"],
+            }
+
+        loss, grads = jax.jit(
+            jax.shard_map(
+                grads_fn, mesh=mesh,
+                in_specs=(pspec, P(), P()),
+                out_specs=(P(), pspec),
+                check_vma=False,
+            )
+        )(params, tokens, labels)
+
+        ref_loss, ref_grads = jax.value_and_grad(_dense_loss(pp))(
+            params, tokens, labels
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for k in ("word", "pos", "w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_grads[k]), atol=2e-5,
+                err_msg=f"grad {k}",
+            )
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+# ---------------------------------------------------------------------------
+# group-mask unit tests
+# ---------------------------------------------------------------------------
+
+def _pp8():
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=1, pipeline_model_parallel_size_=8,
+    )
+    return parallel_state.get_mesh()
+
+
+def test_sync_embedding_grads_drops_non_group_junk():
+    """Middle stages may carry garbage in the tied-table grad slot (the
+    reference's middle ranks simply are not in the embedding group); the
+    masked psum must drop those contributions."""
+    mesh = _pp8()
+    try:
+        gw = jnp.arange(12.0).reshape(3, 4)
+
+        def local(gw):
+            rank = jax.lax.axis_index(parallel_state.PIPELINE_AXIS)
+            contrib = jnp.where(
+                rank == 0, gw, jnp.where(rank == 7, 2.0 * gw, 777.0)
+            )
+            return sync_embedding_grads({"word": contrib})["word"]
+
+        out = jax.jit(
+            jax.shard_map(
+                local, mesh=mesh, in_specs=(P(),), out_specs=P(None, None),
+                check_vma=False,
+            )
+        )(gw)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(3.0 * gw))
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_sync_embedding_grads_split_rank_included():
+    """With a pipeline split rank (encoder-decoder), the split stage joins
+    the embedding group (reference parallel_state.py:352-375)."""
+    mesh = _pp8()
+    try:
+        parallel_state.set_pipeline_model_parallel_split_rank(4)
+        gw = jnp.ones((2, 2))
+
+        def local(gw):
+            rank = jax.lax.axis_index(parallel_state.PIPELINE_AXIS)
+            contrib = jnp.where(
+                rank == 0, gw,
+                jnp.where(rank == 4, 10.0 * gw,
+                          jnp.where(rank == 7, 100.0 * gw, 555.0)),
+            )
+            return sync_embedding_grads({"word": contrib})["word"]
+
+        out = jax.jit(
+            jax.shard_map(
+                local, mesh=mesh, in_specs=(P(),), out_specs=P(None, None),
+                check_vma=False,
+            )
+        )(gw)
+        np.testing.assert_allclose(np.asarray(out), 111.0 * np.ones((2, 2)))
+
+        def pos_local(gw):
+            rank = jax.lax.axis_index(parallel_state.PIPELINE_AXIS)
+            contrib = jnp.where(
+                rank == 0, gw, jnp.where(rank == 4, 10.0 * gw, 555.0)
+            )
+            return sync_position_embedding_grads({"pos": contrib})["pos"]
+
+        out = jax.jit(
+            jax.shard_map(
+                pos_local, mesh=mesh, in_specs=(P(),),
+                out_specs=P(None, None), check_vma=False,
+            )
+        )(gw)
+        np.testing.assert_allclose(np.asarray(out), 11.0 * np.ones((2, 2)))
+    finally:
+        parallel_state.destroy_model_parallel()
