@@ -99,5 +99,9 @@ def lm_head_cross_entropy(
     def body(carry, xs):
         return carry, chunk_loss(head_weight, xs)
 
+    # NB: measured on v5e (345M bench): unroll=True here is ~6 ms/step
+    # SLOWER — unrolling lets several [chunk, V] fp32 logit blocks go live
+    # concurrently and the memory pressure costs more than the rolled
+    # scan's slice overhead. Keep the rolled scan.
     _, losses = jax.lax.scan(body, None, (hc, lc))
     return losses.reshape(n)

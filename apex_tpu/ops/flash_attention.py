@@ -220,6 +220,14 @@ def _mask_scores(s, qi, ki, *, causal, have_mask, mask_ref, have_segs,
 # ---------------------------------------------------------------------------
 
 
+def _scaled_q(q_ref, scale):
+    """The softmax scale folded into the [bq, d] q block (16x cheaper than
+    scaling the [bq, bk] score tile; fp32 mul before the cast keeps the
+    rounding to one step). Shared by fwd/dq/dkv so the score computation
+    cannot desynchronise between kernels."""
+    return (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, bias_ref, mask_ref, segq_ref, segk_ref, seed_ref,
     o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -238,13 +246,13 @@ def _fwd_kernel(
     def compute():
         # dots run in the INPUT dtype with fp32 accumulation — bf16 inputs
         # hit the MXU's native rate; upcasting first would force the slow
-        # fp32 matmul path
-        q = q_ref[0, 0]  # [bq, d]
+        # fp32 matmul path. The softmax scale rides in with q (_scaled_q)
+        q = _scaled_q(q_ref, scale)
         k = k_ref[0, 0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
+        )  # [bq, bk]
         if have_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
 
@@ -257,11 +265,16 @@ def _fwd_kernel(
         m_prev = m_scr[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        # guard fully-masked rows: exp(-inf - -inf) -> use 0 contribution
         p = jnp.exp(s - m_new)
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
-        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+        if have_mask or have_segs or have_bias:
+            # guard fully-masked rows: exp(-inf - -inf) -> 0 contribution
+            # (a bias row folded to -1e30 can fully mask too). Pure-causal/
+            # unmasked tiles never produce a fully-masked row, and their
+            # -1e30 entries underflow exp to exact 0 already — skip the
+            # two extra [bq, bk] VPU passes on that hot path.
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+            alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
 
         # softmax normalizer uses the UNDROPPED probabilities; dropout hits
         # only the value accumulation (standard attention-dropout semantics:
@@ -402,6 +415,9 @@ def _fwd(
     ]
     o, lse = pl.pallas_call(
         kernel,
+        # stable kernel id: remat policies save these outputs by name
+        # (standalone_transformer_lm._selective_policy)
+        name="apex_tpu_flash_fwd",
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -462,12 +478,12 @@ def _bwd_dq_kernel(
         dbias_ref[0, 0] = jnp.zeros_like(dbias_ref[0, 0])
 
     def compute():
-        q = q_ref[0, 0]
+        q = _scaled_q(q_ref, scale)
         k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
+        )
         if have_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         qi, ki = _tile_indices(iq, ik, block_q, block_k)
@@ -477,7 +493,11 @@ def _bwd_dq_kernel(
         )
         lse = lse_ref[0, 0][:, :1]  # [bq, 1]
         p = jnp.exp(s - lse)
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        if have_mask or have_segs or have_bias:
+            # fully-masked rows have lse = -inf (see _fwd_kernel; a -1e30
+            # folded-mask bias counts); without them the -1e30 scores
+            # underflow exp to 0 already
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         do = do_ref[0, 0]
         dp = jax.lax.dot_general(
             do, v_ref[0, 0],
@@ -514,10 +534,14 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, mask_ref,
-    segq_ref, segk_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, n_q, n_heads, have_bias, have_mask,
-    have_segs, dropout_p,
+    segq_ref, segk_ref, seed_ref, dk_ref, dv_ref, *rest,
+    scale, causal, block_q, block_k, n_q, n_heads, have_bias, have_mask,
+    have_segs, dropout_p, emit_dq=False,
 ):
+    # with emit_dq (single-k-block fast path): rest = (dq_ref, dk_scr, dv_scr)
+    # and delta_ref carries O itself (delta computed in-kernel)
+    dq_ref = rest[0] if emit_dq else None
+    dk_scr, dv_scr = rest[-2], rest[-1]
     ib, ih = pl.program_id(0), pl.program_id(1)
     ik, iq = pl.program_id(2), pl.program_id(3)
 
@@ -527,12 +551,14 @@ def _bwd_dkv_kernel(
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def compute():
-        q = q_ref[0, 0]
+        # NB: dk accumulates dsT @ q_scaled directly — the chain-rule
+        # *scale rides in with _scaled_q
+        q = _scaled_q(q_ref, scale)
         k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
+        )  # [bq, bk]
         if have_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         qi, ki = _tile_indices(iq, ik, block_q, block_k)
@@ -542,7 +568,9 @@ def _bwd_dkv_kernel(
         )
         lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(s - lse)
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        if have_mask or have_segs or have_bias:
+            # same fully-masked-row guard rationale as the dq kernel
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         do = do_ref[0, 0]
         if dropout_p > 0.0:
             bh = ib * n_heads + ih
@@ -563,13 +591,30 @@ def _bwd_dkv_kernel(
         )
         if keep is not None:
             dp = dp * keep * (1.0 / (1.0 - dropout_p))
-        delta = delta_ref[0, 0][:, :1]
+        if emit_dq:
+            # delta_ref holds O: delta = rowsum(do * o) computed here, so
+            # the XLA-side delta pass (+ its [.., 1] re-layout) disappears
+            delta = jnp.sum(
+                do.astype(jnp.float32) * delta_ref[0, 0].astype(jnp.float32),
+                axis=1, keepdims=True,
+            )
+        else:
+            delta = delta_ref[0, 0][:, :1]
         ds = p * (dp - delta)  # [bq, bk]
-        # dk += ds.T @ q * scale
+        # dk += ds.T @ q_scaled (the chain-rule *scale rode in with q)
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
+        )
+        if emit_dq:
+            # single-k-block fast path (n_k == 1): every iq block is
+            # visited exactly once, so dq = ds @ k * scale is complete
+            # here — the separate dq kernel (a second score recompute,
+            # exp, and do@v.T) is skipped entirely
+            dq_ref[0, 0] = (jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale).astype(dq_ref.dtype)
 
     if causal:
         @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
@@ -605,14 +650,20 @@ def _bwd(
     # actually needs a gradient (bias_grad=False: ALiBi slopes, folded
     # masks — constants whose cotangent would be discarded)
     emit_dbias = have_bias and bias_grad
+    # single-k-block fast path decided early: it also computes delta
+    # in-kernel from O, skipping the XLA delta pass entirely
+    fuse_dq = n_k == 1 and not emit_dbias
 
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    )  # [b, n, s_q]
+    if fuse_dq:
+        delta_b = None
+    else:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        )  # [b, n, s_q]
+        delta_b = delta[..., None]
     # row stats as lane-dim-1 buffers (tiny DMA per block; the same layout
     # trick as ops/layer_norm.py's per-row stat blocks)
     lse_b = lse[..., None]
-    delta_b = delta[..., None]
 
     mask_arg = (
         kv_mask.astype(jnp.int8).reshape(b, 1, s_k)
@@ -675,45 +726,64 @@ def _bwd(
             (1, 1, bq, bk), lambda ib, ih, iq, ik: (ib, ih, iq, ik)))
         dq_out_shape.append(_sds((b, n, s_q, s_k), jnp.float32, *_ins))
 
-    dq_res = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel,
-            scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
-            n_heads=n, have_bias=have_bias, emit_dbias=emit_dbias,
-            have_mask=have_mask, have_segs=have_segs, dropout_p=dropout_p,
-        ),
-        grid=(b, n, n_q, n_k),
-        in_specs=[
-            q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            k_spec(lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
-            k_spec(lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
-            q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            bias_spec_q,
-            mask_spec(False),
-            segq_spec(False),
-            segk_spec(False),
-            seed_spec,
-        ],
-        out_specs=dq_out_specs if emit_dbias else dq_out_specs[0],
-        out_shape=dq_out_shape if emit_dbias else dq_out_shape[0],
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=_compiler_params(),
-        interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, bias_q, mask_arg, segq_arg, segk_arg,
-      seed_arg)
-    if emit_dbias:
-        dq, dbias_full = dq_res
-    else:
-        dq, dbias_full = dq_res, None
+    # single-k-block fast path: with n_k == 1 every (iq) block is visited
+    # exactly once by the dkv kernel, so dq = ds @ k completes in the same
+    # pass — the separate dq kernel (a second score recompute + exp +
+    # do@v.T) is skipped entirely. dbias emission keeps the two-kernel
+    # path (its tile ownership is laid out (iq, ik)).
+    dbias_full = None
+    if not fuse_dq:
+        dq_res = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel,
+                scale=scale, causal=causal, block_q=bq, block_k=bk, n_k=n_k,
+                n_heads=n, have_bias=have_bias, emit_dbias=emit_dbias,
+                have_mask=have_mask, have_segs=have_segs, dropout_p=dropout_p,
+            ),
+            grid=(b, n, n_q, n_k),
+            in_specs=[
+                q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+                k_spec(lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+                k_spec(lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+                q_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+                row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+                row_spec(lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+                bias_spec_q,
+                mask_spec(False),
+                segq_spec(False),
+                segk_spec(False),
+                seed_spec,
+            ],
+            out_specs=dq_out_specs if emit_dbias else dq_out_specs[0],
+            out_shape=dq_out_shape if emit_dbias else dq_out_shape[0],
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            compiler_params=_compiler_params(),
+            interpret=interpret,
+        )(q, k, v, do, lse_b, delta_b, bias_q, mask_arg, segq_arg, segk_arg,
+          seed_arg)
+        if emit_dbias:
+            dq, dbias_full = dq_res
+        else:
+            dq = dq_res
 
-    dk, dv = pl.pallas_call(
+    dkv_out_specs = [
+        k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+        k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+    ]
+    dkv_out_shape = [
+        _sds(k.shape, k.dtype, *_ins),
+        _sds(v.shape, v.dtype, *_ins),
+    ]
+    if fuse_dq:
+        dkv_out_specs.append(q_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)))
+        dkv_out_shape.append(_sds(q.shape, q.dtype, *_ins))
+
+    dkv_res = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
             scale=scale, causal=causal, block_q=bq, block_k=bk, n_q=n_q,
             n_heads=n, have_bias=have_bias, have_mask=have_mask,
-            have_segs=have_segs, dropout_p=dropout_p,
+            have_segs=have_segs, dropout_p=dropout_p, emit_dq=fuse_dq,
         ),
         grid=(b, n, n_k, n_q),
         in_specs=[
@@ -722,29 +792,30 @@ def _bwd(
             k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
             q_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
-            row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            # fused path: the delta slot carries O (delta computed
+            # in-kernel); generic path: the precomputed row deltas
+            q_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)) if fuse_dq
+            else row_spec(lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
             bias_spec_k,
             mask_spec(True),
             segq_spec(True),
             segk_spec(True),
             seed_spec,
         ],
-        out_specs=[
-            k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
-            k_spec(lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
-        ],
-        out_shape=[
-            _sds(k.shape, k.dtype, *_ins),
-            _sds(v.shape, v.dtype, *_ins),
-        ],
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shape,
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         compiler_params=_compiler_params(),
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b, bias_k, mask_arg, segq_arg, segk_arg,
-      seed_arg)
+    )(q, k, v, do, lse_b, o if fuse_dq else delta_b, bias_k, mask_arg,
+      segq_arg, segk_arg, seed_arg)
+    if fuse_dq:
+        dk, dv, dq = dkv_res
+    else:
+        dk, dv = dkv_res
     return dq, dk, dv, dbias_full
 
 

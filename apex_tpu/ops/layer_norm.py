@@ -154,6 +154,8 @@ def _ln_fwd_pallas(x2d, w, b, eps, affine, interpret):
     b2 = (b if (affine and b is not None) else jnp.zeros((hidden,), jnp.float32)).reshape(1, hidden)
     y, mu, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps, affine=affine),
+        # stable kernel id for name-matching remat policies
+        name="apex_tpu_layer_norm_fwd",
         grid=(rows // br,),
         in_specs=[row, vec, vec],
         out_specs=[row, stat, stat],
@@ -204,6 +206,8 @@ def _rms_fwd_pallas(x2d, w, eps, affine, interpret):
     w2 = (w if affine else jnp.ones((hidden,), jnp.float32)).reshape(1, hidden)
     y, rstd = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps, affine=affine),
+        # stable kernel id for name-matching remat policies
+        name="apex_tpu_rms_norm_fwd",
         grid=(rows // br,),
         in_specs=[row, vec],
         out_specs=[row, stat],

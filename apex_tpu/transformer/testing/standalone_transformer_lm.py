@@ -70,6 +70,13 @@ class GPTConfig:
     apply_query_key_layer_scaling: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     recompute_granularity: Optional[str] = None  # None | "full" | "selective"
+    # Layer-scan unroll factor. 1 = one compiled layer body (fast compile,
+    # the default for tests/virtual meshes); -1 = fully unrolled whatever
+    # num_layers is (the single-chip perf configuration: removes the
+    # per-layer dynamic-slice/update machinery — ~40 ms/step on the 345M
+    # bench — at the cost of longer compiles). Intermediate values trade
+    # between.
+    layer_unroll: int = 1
     # None = auto (Pallas flash attention when available & applicable);
     # True forces it (errors if inapplicable); False forces the XLA path.
     use_flash_attention: Optional[bool] = None
@@ -478,6 +485,29 @@ def transformer_layer(
                               deterministic)).astype(dt)
 
 
+# pallas kernels whose forward outputs 'selective' recompute stores: the
+# flash bwd kernel re-derives score tiles from its saved (o, lse), so
+# replaying the fwd kernel in backward is pure waste (~17 MB/layer saved
+# buys back one full fwd flash pass per layer at the 345M bench shape);
+# the O(s) norm outputs skip the LN replay. Deliberately NOT a blanket
+# pallas_call match: the non-flash path's fused-softmax kernel emits the
+# [b, n, s, s] probability tensor — the exact activation selective
+# recompute exists to avoid storing.
+_SELECTIVE_SAVEABLE_KERNELS = frozenset({
+    "apex_tpu_flash_fwd", "apex_tpu_layer_norm_fwd", "apex_tpu_rms_norm_fwd",
+})
+
+
+def _selective_policy(prim, *args, **kwargs):
+    """Megatron 'selective' recompute, flash-aware: save weight-GEMM
+    outputs plus the allowlisted O(s)-output pallas kernels above."""
+    if getattr(prim, "name", "") == "pallas_call":
+        return kwargs.get("name") in _SELECTIVE_SAVEABLE_KERNELS
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable(
+        prim, *args, **kwargs
+    )
+
+
 def transformer_block(
     cfg: GPTConfig,
     layer_params: Dict[str, jax.Array],  # stacked [L, ...]
@@ -512,19 +542,25 @@ def transformer_block(
     if cfg.recompute_granularity == "full":
         body = jax.checkpoint(body)
     elif cfg.recompute_granularity == "selective":
-        body = jax.checkpoint(
-            body,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        )
+        body = jax.checkpoint(body, policy=_selective_policy)
     elif cfg.recompute_granularity is not None:
         raise ValueError(
             f"unknown recompute_granularity "
             f"{cfg.recompute_granularity!r}: use None, 'full' or 'selective'"
         )
 
+    unroll = int(cfg.layer_unroll)
+    if unroll == -1:
+        unroll = L  # "full", tracking num_layers
+    elif unroll < 1:
+        raise ValueError(
+            f"layer_unroll must be >= 1 or the sentinel -1 (full), got "
+            f"{cfg.layer_unroll}"
+        )
     (hidden, _), _ = jax.lax.scan(
         body, (hidden, dropout_key),
         (layer_params, jnp.arange(1, L + 1)), length=L,
+        unroll=max(1, min(unroll, L)),
     )
     return hidden
 

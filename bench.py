@@ -113,6 +113,9 @@ def bench_gpt(iters, batch, seq, remat):
         vocab_size=50304, max_position_embeddings=seq,
         hidden_dropout=0.0, attention_dropout=0.0,
         compute_dtype=jnp.bfloat16, recompute_granularity=remat or None,
+        # fully unrolled layer loop: drops the per-layer dynamic-slice /
+        # update-slice machinery (~40 ms/step here) for longer compiles
+        layer_unroll=-1,
     )
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-4)
@@ -150,6 +153,7 @@ def bench_bert_lamb(iters, batch, seq):
         vocab_size=30592, max_position_embeddings=seq,
         hidden_dropout=0.0, attention_dropout=0.0,
         compute_dtype=jnp.bfloat16, recompute_granularity="selective",
+        layer_unroll=-1,
     )
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
     opt = FusedLAMB(lr=1e-3)

@@ -70,7 +70,8 @@ def pytest_collection_modifyitems(config, items):
         sid for sid in _SLOW_IDS - matched
         if sid.split("::")[0] in collected_files
     }
-    if stale and not config.getoption("-k"):
+    node_selected = any("::" in str(a) for a in config.args)
+    if stale and not config.getoption("-k") and not node_selected:
         import warnings
 
         warnings.warn(

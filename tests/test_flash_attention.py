@@ -449,3 +449,27 @@ def test_lane_block_picks():
     assert _lane_block(1024, 512) == 512    # already legal
     assert _lane_block(1024, 1024) == 1024  # whole dim always legal
     assert _lane_block(72, 8) == 72         # small odd seq -> whole dim
+
+
+def test_bias_folded_full_row_mask_returns_zeros():
+    """A bias row folded to the library's own _NEG_INF (-1e30) fully masks
+    that query row: the kernel must keep the zeros/-inf lse convention
+    (guards stay active on the bias path), matching mha_reference."""
+    b, n, s, d = 1, 2, 32, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (b, n, s, d), jnp.float32) for kk in ks)
+    bias = jnp.zeros((1, 1, s, s), jnp.float32).at[:, :, 5, :].set(-1e30)
+    out = flash_attention(q, k, v, bias=bias, block_q=16, block_k=16)
+    ref = mha_reference(q, k, v, bias=bias)
+    assert jnp.abs(out[:, :, 5]).max() == 0.0
+    assert jnp.abs(out - ref).max() < 2e-5
+    # backward: the bwd-kernel guards must keep masked-row grads at exact
+    # zero and everything finite (lse = -inf rows flow through exp)
+    grads = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, bias=bias, block_q=16, block_k=16) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g in grads:
+        assert jnp.all(jnp.isfinite(g))
+    assert jnp.abs(grads[0][:, :, 5]).max() == 0.0  # dq of the masked row

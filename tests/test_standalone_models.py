@@ -232,3 +232,29 @@ def test_global_vars_lifecycle():
     timers("step").stop()
     assert timers("step").elapsed() >= 0
     gv.destroy_global_vars()
+
+
+def test_selective_policy_saves_named_pallas_outputs():
+    """The flash-aware selective remat policy matches pallas kernels by
+    their pallas_call `name` param — a JAX upgrade that renames that param
+    would silently degrade selective remat back to replaying every flash
+    forward. Pin that the named kernel outputs appear in saved residuals."""
+    from jax._src.ad_checkpoint import saved_residuals
+
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        _selective_policy,
+    )
+
+    def body(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, interpret=True, block_q=16, block_k=16
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 32, 16), jnp.float32)
+    fn = jax.checkpoint(body, policy=_selective_policy)
+    res = saved_residuals(fn, q, q, q)
+    # the flash fwd kernel outputs must be saved, not rematted
+    pallas_saved = [d for _, d in res if "output of pallas_call" in str(d)]
+    assert pallas_saved, [str(d) for _, d in res]
