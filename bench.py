@@ -45,31 +45,48 @@ import os
 import jax
 import jax.numpy as jnp
 
-# nominal bf16 dense peak TFLOP/s by device kind (public cloud specs)
+# nominal bf16 dense peak TFLOP/s and HBM GB/s by device kind (public
+# cloud specs)
 _PEAKS = (
-    ("v5 lite", 197.0),
-    ("v5e", 197.0),
-    ("v6 lite", 918.0),
-    ("v6e", 918.0),
-    ("v5p", 459.0),
-    ("v5", 459.0),  # after the lite checks
-    ("v4", 275.0),
+    ("v5 lite", 197.0, 819.0),
+    ("v5e", 197.0, 819.0),
+    ("v6 lite", 918.0, 1640.0),
+    ("v6e", 918.0, 1640.0),
+    ("v5p", 459.0, 2765.0),
+    ("v5", 459.0, 2765.0),  # after the lite checks
+    ("v4", 275.0, 1228.0),
 )
 
 
+def detect_peaks():
+    """(peak_tflops, tf_recognised, hbm_gbps, hbm_recognised) from one
+    device-kind lookup so the compute and bandwidth roofs cannot drift
+    apart. BENCH_PEAK_TFLOPS / BENCH_PEAK_HBM_GBPS override individually,
+    each marking only ITS roof recognised."""
+    env_tf = os.environ.get("BENCH_PEAK_TFLOPS")
+    env_bw = os.environ.get("BENCH_PEAK_HBM_GBPS")
+    tf, bw, found = 197.0, 819.0, False
+    if jax.default_backend() == "tpu":
+        kind = jax.devices()[0].device_kind.lower()
+        for marker, peak, gbps in _PEAKS:
+            if marker in kind:
+                tf, bw, found = peak, gbps, True
+                break
+    else:
+        tf, bw = 10.0, 100.0
+    tf_rec = bw_rec = found
+    if env_tf:
+        tf, tf_rec = float(env_tf), True
+    if env_bw:
+        bw, bw_rec = float(env_bw), True
+    return tf, tf_rec, bw, bw_rec
+
+
 def detect_peak_tflops():
-    """(peak, recognised) — BENCH_PEAK_TFLOPS overrides, then the
-    device-kind table."""
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env), True
-    if jax.default_backend() != "tpu":
-        return 10.0, False
-    kind = jax.devices()[0].device_kind.lower()
-    for marker, peak in _PEAKS:
-        if marker in kind:
-            return peak, True
-    return 197.0, False
+    """(peak, recognised) — kept for callers that only need the compute
+    roof."""
+    tf, tf_rec, _, _ = detect_peaks()
+    return tf, tf_rec
 
 
 def train_flops_per_step(L, h, ffn, V, b, s, causal=True):
@@ -224,10 +241,24 @@ def bench_resnet_o2(iters, batch):
         return params, new_bstats, opt_state, sstate, loss
 
     train_step = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
+    # XLA's own cost model for the WHOLE compiled step (2-flops-per-MAC,
+    # same convention as train_flops_per_step): gives a whole-step mfu AND
+    # the roofline diagnosis — ResNet at this batch is HBM-bandwidth
+    # bound, so the interesting number is achieved-vs-roofline, not mfu.
+    # The compiled executable is reused for timing (no second compile).
+    compiled = train_step.lower(
+        params, bstats, opt_state, sstate, jnp.float32(0)
+    ).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    flops = float(ca.get("flops", 0.0))
+    bytes_accessed = float(ca.get("bytes accessed", 0.0))
     dt, final_loss = _timed_steps(
-        train_step, (params, bstats, opt_state, sstate, jnp.float32(0)),
+        compiled, (params, bstats, opt_state, sstate, jnp.float32(0)),
         iters)
-    return dt / iters, final_loss
+    return dt / iters, final_loss, flops, bytes_accessed
 
 
 def _resnet_loss(model, params, bstats, x, y):
@@ -296,9 +327,25 @@ def main() -> None:
     resnet = None
     if not fast:
         r_batch = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
-        r_step, r_loss = bench_resnet_o2(iters, r_batch)
+        r_step, r_loss, r_flops, r_bytes = bench_resnet_o2(iters, r_batch)
         if not math.isfinite(r_loss):
             raise SystemExit(f"ResNet final loss is not finite: {r_loss}")
+        _, _, hbm_gbps, hbm_recognised = detect_peaks()
+        r_mfu = r_flops / r_step / 1e12 / peak if r_flops else None
+        if r_mfu is not None and r_mfu >= 1.0 and recognised:
+            raise SystemExit(
+                f"ResNet implied mfu {r_mfu:.2f} >= 1 — the measurement "
+                "is not timing real execution")
+        # roofline cap: with arithmetic intensity I = flops/bytes below the
+        # machine balance, the best possible mfu is I * BW / peak. NB the
+        # bytes come from XLA's PRE-fusion cost model (an upper estimate),
+        # so pct_of_roofline can exceed 1 slightly when fusion removes
+        # traffic. Only emitted when the device's roofs were recognised —
+        # fallback constants would make the diagnosis fiction.
+        r_roofline = (
+            min(1.0, (r_flops / r_bytes) * hbm_gbps * 1e9 / (peak * 1e12))
+            if r_flops and r_bytes and hbm_recognised else None
+        )
         resnet = {
             "step_ms": round(r_step * 1000.0, 2),
             "images_per_sec": round(r_batch / r_step, 1),
@@ -306,6 +353,22 @@ def main() -> None:
             "batch": r_batch,
             "optimizer": "FusedSGD",
             "opt_level": "O2",
+            # whole-step basis (XLA cost model: convs + BN + loss + opt),
+            # unlike the GPT/BERT true_mfu which counts model matmuls only
+            "whole_step_mfu": round(r_mfu, 4) if r_mfu else None,
+            "roofline_mfu_cap": (
+                round(r_roofline, 4) if r_roofline else None
+            ),
+            "pct_of_roofline": (
+                round(r_mfu / r_roofline, 4)
+                if r_mfu and r_roofline else None
+            ),
+            # the cap is min(1, ...)-clamped: cap < 1 means the HBM roof
+            # sits strictly below the compute roof
+            "bound_by": (
+                None if r_roofline is None
+                else ("hbm" if r_roofline < 1.0 else "compute")
+            ),
         }
 
     vs_baseline = None

@@ -451,15 +451,22 @@ def test_lane_block_picks():
     assert _lane_block(72, 8) == 72         # small odd seq -> whole dim
 
 
-def test_bias_folded_full_row_mask_returns_zeros():
+@pytest.mark.parametrize("block_k,bias_grad", [
+    (16, True),   # generic two-kernel backward (n_k=2)
+    (32, True),   # n_k=1 but dbias emission keeps the two-kernel path
+    (32, False),  # the fused single-k-block backward, bias streamed
+])
+def test_bias_folded_full_row_mask_returns_zeros(block_k, bias_grad):
     """A bias row folded to the library's own _NEG_INF (-1e30) fully masks
     that query row: the kernel must keep the zeros/-inf lse convention
-    (guards stay active on the bias path), matching mha_reference."""
+    (guards stay active on the bias path), matching mha_reference — on
+    the generic AND fused backward paths."""
     b, n, s, d = 1, 2, 32, 16
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
     q, k, v = (jax.random.normal(kk, (b, n, s, d), jnp.float32) for kk in ks)
     bias = jnp.zeros((1, 1, s, s), jnp.float32).at[:, :, 5, :].set(-1e30)
-    out = flash_attention(q, k, v, bias=bias, block_q=16, block_k=16)
+    out = flash_attention(q, k, v, bias=bias, block_q=16, block_k=block_k,
+                          bias_grad=bias_grad)
     ref = mha_reference(q, k, v, bias=bias)
     assert jnp.abs(out[:, :, 5]).max() == 0.0
     assert jnp.abs(out - ref).max() < 2e-5
@@ -467,7 +474,8 @@ def test_bias_folded_full_row_mask_returns_zeros():
     # zero and everything finite (lse = -inf rows flow through exp)
     grads = jax.grad(
         lambda q, k, v: jnp.sum(flash_attention(
-            q, k, v, bias=bias, block_q=16, block_k=16) ** 2),
+            q, k, v, bias=bias, block_q=16, block_k=block_k,
+            bias_grad=bias_grad) ** 2),
         argnums=(0, 1, 2),
     )(q, k, v)
     for g in grads:
