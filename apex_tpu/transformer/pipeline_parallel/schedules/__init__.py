@@ -7,6 +7,7 @@ from .fwd_bwd_pipelining_with_interleaving import (  # noqa: F401
     pipeline_forward_backward_interleaved,
     run_pipeline_interleaved,
 )
+from .fwd_bwd_1f1b import pipeline_forward_backward_1f1b  # noqa: F401
 from .fwd_bwd_pipelining_without_interleaving import (  # noqa: F401
     pipeline_forward_backward,
     run_pipeline,
@@ -19,7 +20,15 @@ def get_forward_backward_func(
 ):
     """Pick the schedule exactly as the reference does (``__init__.py:22-59``):
     no-pipelining for pp == 1; interleaved when virtual pipelining is
-    configured; 1F1B otherwise."""
+    configured; 1F1B otherwise.
+
+    The default non-interleaved schedule here is the scan-autodiff
+    :func:`pipeline_forward_backward` (supports virtual chunks and
+    ``tick_checkpoint``). For the reference's O(pp) activation-memory
+    bound — in-flight activations independent of the microbatch count —
+    use :func:`pipeline_forward_backward_1f1b`, which runs the backward
+    inside the schedule (per-microbatch vjp residuals in a ``2pp-1``-slot
+    ring) instead of differentiating through it."""
     if pipeline_model_parallel_size is None:
         pipeline_model_parallel_size = (
             parallel_state.get_pipeline_model_parallel_world_size()

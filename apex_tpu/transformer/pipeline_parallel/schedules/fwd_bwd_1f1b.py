@@ -1,0 +1,222 @@
+"""True 1F1B pipeline schedule: O(pp) in-flight activations.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py:241-597`` — warmup
+(``pp - rank - 1`` forwards), steady 1F1B (one forward + one backward per
+step), cooldown; each rank holds at most ``pp`` in-flight microbatch
+activation sets, so pipeline memory is independent of the number of
+microbatches.
+
+The scan-autodiff schedules in this package
+(:func:`..fwd_bwd_pipelining_without_interleaving.pipeline_forward_backward`)
+differentiate THROUGH the schedule, so reverse-mode saves O(n_micro)
+stage-boundary activations (O(total/K) with ``tick_checkpoint``). This
+module instead runs the backward INSIDE the forward scan — the schedule
+itself computes gradients — which restores the reference's memory bound:
+
+- Each scan iteration is one (F, B) double-tick. Rank ``r`` forwards
+  microbatch ``i - r`` and backwards microbatch ``i - 2(pp-1) + r``;
+  activations hop rank-to-rank by ``ppermute`` (+1 forward, -1 backward).
+  The last stage closes the loop in the same iteration: its fresh forward
+  output feeds its loss gradient, which is the same microbatch its B
+  sub-tick consumes — textbook 1F1B.
+- Per-microbatch stage residuals (the ``jax.vjp`` closure's arrays, minus
+  leaves that ARE the stage parameters — weights are shared, not
+  per-microbatch) live in a ``2pp - 1``-slot ring buffer. A microbatch's
+  residuals are written at iteration ``m + r`` and read at
+  ``m + 2(pp-1) - r``, a lifetime < ``2pp - 1``, so slots never collide
+  and peak activation memory is O(pp) — independent of ``n_micro``
+  (asserted by ``tests/test_pipeline_1f1b.py`` via
+  ``compile().memory_analysis()``).
+
+SPMD note: all ranks share one program and one (static) buffer size, so
+the uniform window is ``2(pp-1)`` rather than the reference's per-rank
+``pp - rank`` — the same O(pp) class, paid once per rank instead of
+rank-staggered. Bubble: ``2(pp-1)`` double-ticks over ``n + 2(pp-1)``
+total, the reference's ``(pp-1)/m`` fraction.
+
+Residual caveat: leaves are deduplicated against ``stage_params`` by
+trace-time object identity. A stage that casts its weights (e.g.
+``w.astype(bf16)``) stores the CAST copy per slot; pass pre-cast
+parameters to 1F1B stages (as Megatron's bf16 training does) to keep the
+ring buffer to activations only.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ... import parallel_state
+from ..utils import pvary_union_like
+
+Pytree = Any
+
+
+def pipeline_forward_backward_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Pytree,
+    inputs: jax.Array,  # [n_micro, ...] first-stage activations
+    extras: Optional[Pytree] = None,  # [n_micro, ...] loss inputs (labels)
+    *,
+    axis_name: Optional[str] = None,
+    grad_scaler: Optional[Callable] = None,
+    with_dinputs: bool = True,
+):
+    """1F1B forward+backward inside ``shard_map``; same contract as
+    :func:`pipeline_forward_backward`: returns ``(mean_loss, grads,
+    dinputs)`` with the loss psum-broadcast, ``grads`` w.r.t. the local
+    ``stage_params`` (summed over microbatches of the 1/n-scaled loss)
+    and ``dinputs`` the gradient w.r.t. ``inputs`` (nonzero on stage 0,
+    synced over the axis). ``grad_scaler`` must be linear (loss scaling).
+
+    ``with_dinputs=False`` skips the input-gradient accumulation and
+    returns ``dinputs=None``. The dinputs buffer is ``[n_micro, ...]`` —
+    inherently O(n_micro), exactly like ``inputs`` itself — so a trainer
+    that handles the embedding gradient separately (the reference layout)
+    should disable it to keep the schedule's TEMP memory strictly O(pp).
+    """
+    a = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
+    pp = jax.lax.axis_size(a)
+    rank = jax.lax.axis_index(a)
+    n = inputs.shape[0]
+    if extras is None:
+        extras = jnp.zeros((n,))
+    W = max(2 * pp - 1, 1)
+    T = n + 2 * (pp - 1)
+    perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+    perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def scaled_loss(y, ex):
+        val = loss_fn(y, ex) / n
+        if grad_scaler is not None:
+            val = grad_scaler(val)
+        return val
+
+    def stage_vjp_flat(x):
+        y, vjp_fn = jax.vjp(stage_fn, stage_params, x)
+        flat, treedef = jax.tree_util.tree_flatten(vjp_fn)
+        return y, flat, treedef
+
+    # which residual leaves are the stage parameters themselves (weights
+    # are shared across microbatches — never ring-buffered)?
+    param_leaves = jax.tree_util.tree_leaves(stage_params)
+    param_ids = {id(p) for p in param_leaves}
+    x0 = jnp.zeros_like(inputs[0])
+    y0, flat0, treedef = stage_vjp_flat(x0)
+    is_param = [id(r) in param_ids for r in flat0]
+    buf_shapes = [
+        (r.shape, r.dtype) for r, p in zip(flat0, is_param) if not p
+    ]
+    del y0, flat0
+
+    def body(carry, i):
+        fwd_msg, bwd_msg, res_buf, grad_acc, loss_acc, dinputs = carry
+
+        # ---- F sub-tick: rank r forwards microbatch i - r -------------
+        m_f = i - rank
+        inj = jax.lax.dynamic_index_in_dim(
+            inputs, jnp.clip(m_f, 0, n - 1), 0, keepdims=False
+        )
+        x = jnp.where(rank == 0, inj, fwd_msg).astype(inputs.dtype)
+        y, flat, _ = stage_vjp_flat(x)
+        slot_w = jnp.mod(i, W)
+        acts = [r for r, p in zip(flat, is_param) if not p]
+        res_buf = [
+            jax.lax.dynamic_update_index_in_dim(
+                b, r.astype(b.dtype), slot_w, 0
+            )
+            for b, r in zip(res_buf, acts)
+        ]
+
+        # ---- last stage: loss + its own backward seed -----------------
+        m_l = i - (pp - 1)
+        ex = jax.tree_util.tree_map(
+            lambda e: jax.lax.dynamic_index_in_dim(
+                e, jnp.clip(m_l, 0, n - 1), 0, keepdims=False
+            ),
+            extras,
+        )
+        loss_m, dy_self = jax.value_and_grad(scaled_loss)(y, ex)
+        active_l = (m_l >= 0) & (m_l < n) & (rank == pp - 1)
+        loss_acc = loss_acc + jnp.where(active_l, loss_m, 0.0)
+
+        # ---- B sub-tick: rank r backwards microbatch i-2(pp-1)+r ------
+        m_b = i - 2 * (pp - 1) + rank
+        active_b = (m_b >= 0) & (m_b < n)
+        dy = jnp.where(rank == pp - 1, dy_self.astype(bwd_msg.dtype),
+                       bwd_msg)
+        slot_r = jnp.mod(m_b + rank, W)
+        read = [
+            jax.lax.dynamic_index_in_dim(
+                b, jnp.clip(slot_r, 0, W - 1), 0, keepdims=False
+            )
+            for b in res_buf
+        ]
+        # reassemble the vjp closure: live leaves where the residual IS a
+        # parameter (positions are static — same stage_fn, same shapes
+        # every iteration), ring-buffered activations elsewhere
+        merged = []
+        read_iter = iter(read)
+        for r, p in zip(flat, is_param):
+            merged.append(r if p else next(read_iter))
+        vjp_fn = jax.tree_util.tree_unflatten(treedef, merged)
+        dparams, dx = vjp_fn(dy.astype(y.dtype))
+        grad_acc = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(active_b, d.astype(g.dtype), 0.0),
+            grad_acc, dparams,
+        )
+        # stage-0 input gradients accumulate into the [n, ...] output
+        if dinputs is not None:
+            dinputs = jax.lax.dynamic_update_index_in_dim(
+                dinputs,
+                jnp.where(
+                    active_b & (rank == 0),
+                    dx.astype(dinputs.dtype),
+                    jax.lax.dynamic_index_in_dim(
+                        dinputs, jnp.clip(m_b, 0, n - 1), 0, keepdims=False
+                    ),
+                ),
+                jnp.clip(m_b, 0, n - 1), 0,
+            )
+
+        # ---- ring hops ------------------------------------------------
+        fwd_next = jax.lax.ppermute(y.astype(fwd_msg.dtype), a, perm_fwd)
+        bwd_next = jax.lax.ppermute(dx.astype(bwd_msg.dtype), a, perm_bwd)
+        return (fwd_next, bwd_next, res_buf, grad_acc, loss_acc,
+                dinputs), None
+
+    operands = (stage_params, inputs)
+    fwd0 = pvary_union_like(jnp.zeros_like(inputs[0]), operands, (a,))
+    bwd0 = pvary_union_like(jnp.zeros_like(inputs[0]), operands, (a,))
+    res0 = [
+        pvary_union_like(jnp.zeros((W,) + s, d), operands, (a,))
+        for s, d in buf_shapes
+    ]
+    grad0 = jax.tree_util.tree_map(
+        lambda p: pvary_union_like(
+            jnp.zeros(p.shape, jnp.float32), operands, (a,)
+        ),
+        stage_params,
+    )
+    loss0 = pvary_union_like(jnp.zeros((), jnp.float32), operands, (a,))
+    din0 = (
+        pvary_union_like(jnp.zeros_like(inputs), operands, (a,))
+        if with_dinputs else None
+    )
+
+    (_, _, _, grads, loss, dinputs), _ = jax.lax.scan(
+        body, (fwd0, bwd0, res0, grad0, loss0, din0), jnp.arange(T)
+    )
+    loss = jax.lax.psum(loss, a)
+    if dinputs is not None:
+        dinputs = jax.lax.psum(dinputs, a)
+    # grads accumulate in fp32 across microbatches (the reference's
+    # fp32 main-grad discipline) but return in the PARAM dtype to match
+    # pipeline_forward_backward's contract exactly
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, stage_params
+    )
+    return loss, grads, dinputs

@@ -279,7 +279,7 @@ def main() -> None:
     iters = int(os.environ.get("BENCH_ITERS", "10"))
     fast = os.environ.get("BENCH_FAST")
 
-    peak, recognised = detect_peak_tflops()
+    peak, recognised, hbm_gbps, hbm_recognised = detect_peaks()
 
     step_s, final_loss, flops = bench_gpt(iters, batch, seq, remat)
     if not math.isfinite(final_loss):
@@ -330,7 +330,6 @@ def main() -> None:
         r_step, r_loss, r_flops, r_bytes = bench_resnet_o2(iters, r_batch)
         if not math.isfinite(r_loss):
             raise SystemExit(f"ResNet final loss is not finite: {r_loss}")
-        _, _, hbm_gbps, hbm_recognised = detect_peaks()
         r_mfu = r_flops / r_step / 1e12 / peak if r_flops else None
         if r_mfu is not None and r_mfu >= 1.0 and recognised:
             raise SystemExit(
@@ -344,7 +343,8 @@ def main() -> None:
         # fallback constants would make the diagnosis fiction.
         r_roofline = (
             min(1.0, (r_flops / r_bytes) * hbm_gbps * 1e9 / (peak * 1e12))
-            if r_flops and r_bytes and hbm_recognised else None
+            if r_flops and r_bytes and hbm_recognised and recognised
+            else None
         )
         resnet = {
             "step_ms": round(r_step * 1000.0, 2),

@@ -1,0 +1,210 @@
+"""True-1F1B schedule: gradient parity + the O(pp) memory bound.
+
+The headline claim (VERDICT round-3 item 2): unlike the scan-autodiff
+schedules, :func:`pipeline_forward_backward_1f1b`'s peak activation
+memory is INDEPENDENT of the number of microbatches at fixed pp —
+asserted here via ``compile().memory_analysis()``, not just documented.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    pipeline_forward_backward,
+    pipeline_forward_backward_1f1b,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import pvary_full
+
+PP = 4
+H = 8
+MBS = 4
+
+
+@pytest.fixture
+def pp_mesh():
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=1, pipeline_model_parallel_size_=PP,
+        devices=jax.devices()[:PP],
+    )
+    yield parallel_state.get_mesh()
+    parallel_state.destroy_model_parallel()
+
+
+def _stage_fn(lp, x):
+    return jnp.tanh(jnp.einsum("...h,oh->...o", x, lp["w"]) + lp["b"])
+
+
+def _loss_fn(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+
+def _make(n_micro, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), PP + 2)
+    params = {
+        "w": jnp.stack([jax.random.normal(k, (H, H)) * 0.5
+                        for k in ks[:PP]]),
+        "b": jnp.zeros((PP, H)),
+    }
+    inputs = jax.random.normal(ks[PP], (n_micro, MBS, H))
+    targets = jax.random.normal(ks[PP + 1], (n_micro, MBS, H))
+    return params, inputs, targets
+
+
+def _dense(params, inputs, targets):
+    total = 0.0
+    for m in range(inputs.shape[0]):
+        h = inputs[m]
+        for s in range(PP):
+            h = _stage_fn({"w": params["w"][s], "b": params["b"][s]}, h)
+        total = total + _loss_fn(h, targets[m])
+    return total / inputs.shape[0]
+
+
+def _run_1f1b(mesh, params, inputs, targets):
+    pl = parallel_state.PIPELINE_AXIS
+    pspec = {"w": P(pl, None, None), "b": P(pl, None)}
+
+    def local(params, inputs, targets):
+        stage_p = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage_p = pvary_full(stage_p, (pl,))
+        inputs = pvary_full(inputs, (pl,))
+        targets = pvary_full(targets, (pl,))
+        loss, grads, dinp = pipeline_forward_backward_1f1b(
+            _stage_fn, _loss_fn, stage_p, inputs, targets, axis_name=pl,
+        )
+        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+        return loss, grads, dinp
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec, P()), check_vma=True,
+    ))
+
+
+def test_1f1b_matches_dense_and_scan_schedule(pp_mesh):
+    n = 8
+    params, inputs, targets = _make(n)
+    loss, grads, dinp = _run_1f1b(pp_mesh, params, inputs, targets)(
+        params, inputs, targets
+    )
+    ref_loss, (ref_grads, ref_dinp) = jax.value_and_grad(
+        _dense, argnums=(0, 1)
+    )(params, inputs, targets)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]), atol=1e-5,
+            err_msg=f"grad {k}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(dinp), np.asarray(ref_dinp), atol=1e-5,
+    )
+
+    # and against the scan-autodiff schedule (same mesh, same math)
+    pl = parallel_state.PIPELINE_AXIS
+    pspec = {"w": P(pl, None, None), "b": P(pl, None)}
+
+    def local_scan(params, inputs, targets):
+        stage_p = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage_p = pvary_full(stage_p, (pl,))
+        inputs = pvary_full(inputs, (pl,))
+        targets = pvary_full(targets, (pl,))
+        loss, grads, _ = pipeline_forward_backward(
+            _stage_fn, _loss_fn, stage_p, inputs, targets, axis_name=pl,
+        )
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss2, grads2 = jax.jit(jax.shard_map(
+        local_scan, mesh=pp_mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec), check_vma=True,
+    ))(params, inputs, targets)
+    np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(grads2[k]), atol=1e-5,
+        )
+
+
+def test_1f1b_odd_microbatch_counts(pp_mesh):
+    """n not divisible by pp and n < pp both schedule correctly."""
+    for n in (2, 5):
+        params, inputs, targets = _make(n, key=n)
+        loss, grads, _ = _run_1f1b(pp_mesh, params, inputs, targets)(
+            params, inputs, targets
+        )
+        ref_loss, ref_grads = jax.value_and_grad(_dense)(
+            params, inputs, targets
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads["w"]), np.asarray(ref_grads["w"]), atol=1e-5,
+        )
+
+
+def test_1f1b_peak_memory_independent_of_n_micro(pp_mesh):
+    """The headline memory claim: peak temp bytes at n_micro=32 stay
+    within ~10% of n_micro=8. The [n, ...] inputs are arguments, not
+    temp; dinputs (also inherently [n, ...]) is disabled as a trainer
+    that owns the embedding gradient would — temp then holds the O(pp)
+    residual ring + per-tick workspace only."""
+    pl = parallel_state.PIPELINE_AXIS
+    pspec = {"w": P(pl, None, None), "b": P(pl, None)}
+
+    def build(n):
+        params, inputs, targets = _make(n)
+
+        def local(params, inputs, targets):
+            stage_p = jax.tree_util.tree_map(lambda p: p[0], params)
+            stage_p = pvary_full(stage_p, (pl,))
+            inputs = pvary_full(inputs, (pl,))
+            targets = pvary_full(targets, (pl,))
+            loss, grads, _ = pipeline_forward_backward_1f1b(
+                _stage_fn, _loss_fn, stage_p, inputs, targets,
+                axis_name=pl, with_dinputs=False,
+            )
+            return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+        fn = jax.jit(jax.shard_map(
+            local, mesh=pp_mesh, in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec), check_vma=True,
+        ))
+        return fn, (params, inputs, targets)
+
+    def temp_bytes(n):
+        fn, args = build(n)
+        return fn.lower(*args).compile().memory_analysis().temp_size_in_bytes
+
+    small = temp_bytes(8)
+    big = temp_bytes(32)
+    assert big <= small * 1.1, (
+        f"1F1B peak temp grew with n_micro: {small} -> {big} bytes"
+    )
+
+    # contrast: the scan-autodiff schedule's backward residuals DO grow
+    # with n_micro (that is the deficiency 1F1B exists to fix)
+    def scan_temp_bytes(n):
+        params, inputs, targets = _make(n)
+
+        def local(params, inputs, targets):
+            stage_p = jax.tree_util.tree_map(lambda p: p[0], params)
+            stage_p = pvary_full(stage_p, (pl,))
+            inputs = pvary_full(inputs, (pl,))
+            targets = pvary_full(targets, (pl,))
+            loss, grads, _ = pipeline_forward_backward(
+                _stage_fn, _loss_fn, stage_p, inputs, targets,
+                axis_name=pl,
+            )
+            return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+        fn = jax.jit(jax.shard_map(
+            local, mesh=pp_mesh, in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec), check_vma=True,
+        ))
+        return fn.lower(
+            params, inputs, targets
+        ).compile().memory_analysis().temp_size_in_bytes
+
+    assert scan_temp_bytes(32) > scan_temp_bytes(8) * 1.5
