@@ -82,13 +82,6 @@ def detect_peaks():
     return tf, tf_rec, bw, bw_rec
 
 
-def detect_peak_tflops():
-    """(peak, recognised) — kept for callers that only need the compute
-    roof."""
-    tf, tf_rec, _, _ = detect_peaks()
-    return tf, tf_rec
-
-
 def train_flops_per_step(L, h, ffn, V, b, s, causal=True):
     """Useful (true-MFU) matmul FLOPs for one fwd+bwd train step — no
     recompute credit."""
