@@ -1,4 +1,12 @@
 """Fused dense layers (reference ``apex/fused_dense/__init__.py``)."""
+from .fp8 import (  # noqa: F401
+    FP8_E4M3_MAX,
+    Fp8DenseState,
+    Fp8TensorMeta,
+    fp8_fused_dense,
+    init_fp8_dense_state,
+    quantize_e4m3,
+)
 from .fused_dense import (  # noqa: F401
     FusedDense,
     FusedDenseGeluDense,

@@ -1,0 +1,148 @@
+"""fp8 (e4m3) GEMM with TransformerEngine-style delayed scaling.
+
+Reference context: the reference's ``parallel_state`` builds an
+amax-reduction group "for fp8 precision conversion"
+(``apex/transformer/parallel_state.py:280-292``) — the communicator side
+of a TE-style fp8 recipe; the GEMMs themselves live outside apex. Here
+both halves are TPU-native: :func:`apex_tpu.transformer.parallel_state.
+reduce_amax` is the group all-reduce (pmax over the (data, tensor) axes),
+and this module is the fp8 GEMM path for ``fused_dense``.
+
+Delayed scaling (the standard TE recipe): each fp8 tensor carries an
+``amax_history`` ring of the last H observed ``max|x|`` values; the
+quantization scale for step t is derived from the history BEFORE step t's
+amax is recorded, so the scale is available without a pre-pass over the
+data. ``scale = FP8_E4M3_MAX / (max(history) * 2**margin)``.
+
+The backward runs in the INPUT precision (bf16/fp32) via a
+straight-through custom VJP — fp8 forward, high-precision dgrad/wgrad —
+the conservative half of TE's recipe (e5m2 gradient quantization is a
+later step). On chips without native fp8 MXU paths (v5e) XLA upcasts the
+dot; the API and numerics are identical, only the speedup is hardware-
+dependent — ``bench.py`` records the measured ratio.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+FP8_E4M3_MAX = 448.0
+
+
+class Fp8TensorMeta(NamedTuple):
+    """Per-tensor delayed-scaling state."""
+
+    amax_history: jax.Array  # [H] fp32, most recent at index 0
+    scale: jax.Array  # fp32 scalar: multiply BEFORE the e4m3 cast
+
+
+class Fp8DenseState(NamedTuple):
+    """Delayed-scaling state for one fp8 dense layer (x and w metas)."""
+
+    x: Fp8TensorMeta
+    w: Fp8TensorMeta
+
+
+def _init_meta(history_len: int) -> Fp8TensorMeta:
+    return Fp8TensorMeta(
+        amax_history=jnp.zeros((history_len,), jnp.float32),
+        scale=jnp.float32(1.0),
+    )
+
+
+def init_fp8_dense_state(history_len: int = 16) -> Fp8DenseState:
+    return Fp8DenseState(x=_init_meta(history_len), w=_init_meta(history_len))
+
+
+def quantize_e4m3(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """Scale, saturate to the e4m3 range, cast."""
+    xs = x.astype(jnp.float32) * scale
+    xs = jnp.clip(xs, -FP8_E4M3_MAX, FP8_E4M3_MAX)
+    return xs.astype(jnp.float8_e4m3fn)
+
+
+def _updated_meta(meta: Fp8TensorMeta, amax_now: jax.Array,
+                  margin: float) -> Fp8TensorMeta:
+    """Roll the history and derive the NEXT step's scale from it (delayed
+    scaling: ``amax_now`` only influences future scales)."""
+    hist = jnp.concatenate(
+        [amax_now[None].astype(jnp.float32), meta.amax_history[:-1]]
+    )
+    amax = jnp.max(hist)
+    scale = jnp.where(
+        amax > 0.0,
+        FP8_E4M3_MAX / (amax * (2.0 ** margin)),
+        jnp.float32(1.0),
+    )
+    return Fp8TensorMeta(amax_history=hist, scale=scale.astype(jnp.float32))
+
+
+@jax.custom_vjp
+def _fp8_matmul(x, w, scale_x, scale_w):
+    qx = quantize_e4m3(x, scale_x)
+    qw = quantize_e4m3(w, scale_w)
+    y = jnp.einsum(
+        "...i,oi->...o", qx, qw, preferred_element_type=jnp.float32
+    )
+    return (y / (scale_x * scale_w)).astype(x.dtype)
+
+
+def _fp8_matmul_fwd(x, w, scale_x, scale_w):
+    return _fp8_matmul(x, w, scale_x, scale_w), (x, w)
+
+
+def _fp8_matmul_bwd(res, dy):
+    # straight-through: dgrad/wgrad in the input precision (TE's
+    # conservative recipe half; e5m2 grad quantization would slot in here)
+    x, w = res
+    dyf = dy.astype(jnp.float32)
+    dx = jnp.einsum(
+        "...o,oi->...i", dyf, w.astype(jnp.float32)
+    ).astype(x.dtype)
+    dw = jnp.einsum(
+        "...o,...i->oi", dyf, x.astype(jnp.float32)
+    ).astype(w.dtype)
+    return dx, dw, None, None
+
+
+_fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
+def fp8_fused_dense(
+    x: jax.Array,
+    weight: jax.Array,  # [out, in] (torch Linear layout, like fused_dense)
+    bias: Optional[jax.Array],
+    state: Fp8DenseState,
+    *,
+    margin: float = 0.0,
+    amax_reduction_axes=None,
+):
+    """e4m3 GEMM + bias with delayed scaling; returns ``(y, new_state)``.
+
+    Quantizes with the CURRENT state's scales (derived from past history),
+    then records this step's amaxes into the returned state. Inside
+    ``shard_map``, pass ``amax_reduction_axes`` (or rely on
+    ``parallel_state.get_amax_reduction_group()`` via
+    ``parallel_state.reduce_amax``) so every rank sharing a tensor derives
+    the same scale next step.
+    """
+    amax_x = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    amax_w = jnp.max(jnp.abs(weight)).astype(jnp.float32)
+    if amax_reduction_axes is not None:
+        amax_x = jax.lax.pmax(amax_x, amax_reduction_axes)
+        amax_w = jax.lax.pmax(amax_w, amax_reduction_axes)
+    # amaxes describe the data, not the graph — no gradient flows into
+    # the bookkeeping
+    amax_x = jax.lax.stop_gradient(amax_x)
+    amax_w = jax.lax.stop_gradient(amax_w)
+
+    y = _fp8_matmul(x, weight, state.x.scale, state.w.scale)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    new_state = Fp8DenseState(
+        x=_updated_meta(state.x, amax_x, margin),
+        w=_updated_meta(state.w, amax_w, margin),
+    )
+    return y, new_state

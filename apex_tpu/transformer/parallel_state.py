@@ -47,6 +47,7 @@ _DATA_PARALLEL_WORLD_SIZE: Optional[int] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
+_USE_FP8: bool = False
 
 
 def initialize_model_parallel(
@@ -54,6 +55,7 @@ def initialize_model_parallel(
     pipeline_model_parallel_size_: int = 1,
     virtual_pipeline_model_parallel_size_: Optional[int] = None,
     pipeline_model_parallel_split_rank_: Optional[int] = None,
+    use_fp8_: bool = False,
     *,
     devices: Optional[Sequence] = None,
     default_backend: Optional[str] = None,
@@ -75,7 +77,9 @@ def initialize_model_parallel(
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    global _USE_FP8
     del default_backend, p2p_backend
+    _USE_FP8 = bool(use_fp8_)
 
     devs = list(devices) if devices is not None else jax.devices()
     world = len(devs)
@@ -309,6 +313,38 @@ def is_rank_in_position_embedding_group():
     return in_group
 
 
+# --- amax reduction group (fp8, reference :280-292,:472-476) -----------------
+# The reference builds the amax group over tp x dp ranks within one pipeline
+# stage ("Build the amax-reduction groups for fp8 precision conversion",
+# parallel_state.py:280). On a mesh the group IS the (data, tensor) axis
+# pair; the all-reduce is a pmax over those axes (amax = max |x| must agree
+# across ranks holding shards of the same tensor before a shared fp8 scale
+# is derived from it).
+
+def get_amax_reduction_group():
+    """The mesh-axis tuple the fp8 amax all-reduce runs over (reference
+    ``get_amax_reduction_group``, ``parallel_state.py:472-476``). Raises
+    unless ``initialize_model_parallel(..., use_fp8_=True)``, mirroring the
+    reference's assert."""
+    if _MESH is None:
+        raise RuntimeError("model parallel is not initialized")
+    if not _USE_FP8:
+        raise RuntimeError(
+            "amax reduction group is not initialized "
+            "(initialize_model_parallel(..., use_fp8_=True))"
+        )
+    return (DATA_AXIS, TENSOR_AXIS)
+
+
+def reduce_amax(amax, axes=None):
+    """All-reduce an amax statistic over the amax-reduction group (pmax —
+    ranks sharing a tensor's shards must agree on the scale they derive).
+    Inside ``shard_map`` only; ``axes`` overrides the group (e.g. a subset
+    when one axis is not bound)."""
+    a = axes if axes is not None else get_amax_reduction_group()
+    return jax.lax.pmax(amax, a)
+
+
 # --- misc sizes --------------------------------------------------------------
 
 def get_num_layers(
@@ -350,6 +386,7 @@ def destroy_model_parallel() -> None:
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    global _USE_FP8
     _MESH = None
     _TENSOR_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
@@ -357,3 +394,4 @@ def destroy_model_parallel() -> None:
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
+    _USE_FP8 = False

@@ -1,0 +1,155 @@
+"""fp8 (e4m3) fused dense + the amax-reduction group.
+
+Reference parity surface: ``apex/transformer/parallel_state.py:280-292``
+builds amax-reduction groups over the tp x dp ranks when
+``use_fp8_=True`` and exposes ``get_amax_reduction_group`` (``:472``);
+here the group is the (data, tensor) axis pair and the all-reduce is a
+pmax. The GEMM side is the TE-style delayed-scaling recipe.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.fused_dense import (
+    FP8_E4M3_MAX,
+    fp8_fused_dense,
+    fused_dense,
+    init_fp8_dense_state,
+    quantize_e4m3,
+)
+from apex_tpu.transformer import parallel_state
+
+
+def test_amax_reduction_group_api():
+    parallel_state.initialize_model_parallel(2, 2, use_fp8_=True)
+    try:
+        assert parallel_state.get_amax_reduction_group() == (
+            parallel_state.DATA_AXIS, parallel_state.TENSOR_AXIS,
+        )
+    finally:
+        parallel_state.destroy_model_parallel()
+    # without fp8: the reference asserts; we raise
+    parallel_state.initialize_model_parallel(2, 2)
+    try:
+        with pytest.raises(RuntimeError, match="amax reduction group"):
+            parallel_state.get_amax_reduction_group()
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_reduce_amax_is_pmax_over_group():
+    parallel_state.initialize_model_parallel(2, 2, use_fp8_=True)
+    try:
+        mesh = parallel_state.get_mesh()
+
+        def local(x):
+            amax = jnp.max(jnp.abs(x))
+            return parallel_state.reduce_amax(amax)[None]
+
+        x = jnp.arange(8.0).reshape(2, 2, 2) - 3.0
+        out = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P("pipeline", "data", "tensor"),),
+            out_specs=P("pipeline"), check_vma=False,
+        ))(x)
+        # pmax over (data, tensor) only: each PIPELINE slice keeps its own
+        # max (slice 0 holds -3..0 -> 3; slice 1 holds 1..4 -> 4)
+        np.testing.assert_allclose(np.asarray(out), [3.0, 4.0])
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def test_quantize_e4m3_saturates_and_rounds():
+    x = jnp.array([0.0, 1.0, -1.0, 1000.0, -1000.0], jnp.float32)
+    q = quantize_e4m3(x, jnp.float32(1.0))
+    assert q.dtype == jnp.float8_e4m3fn
+    qf = q.astype(jnp.float32)
+    np.testing.assert_allclose(qf[:3], [0.0, 1.0, -1.0])
+    np.testing.assert_allclose(qf[3:], [FP8_E4M3_MAX, -FP8_E4M3_MAX])
+
+
+def test_fp8_dense_matches_fp32_within_e4m3_tolerance():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(k1, (32, 64), jnp.float32)
+    w = jax.random.normal(k2, (48, 64), jnp.float32) * 0.1
+    b = jax.random.normal(k3, (48,), jnp.float32) * 0.1
+    state = init_fp8_dense_state()
+    # one warmup call records amaxes so scales are calibrated
+    _, state = fp8_fused_dense(x, w, b, state)
+    y8, state = fp8_fused_dense(x, w, b, state)
+    y32 = fused_dense(x, w, b)
+    # e4m3: 3 mantissa bits => ~6% per-element rel error, reduced by the
+    # K=64 accumulation; compare against the output RMS
+    rms = float(jnp.sqrt(jnp.mean(y32 ** 2)))
+    err = float(jnp.abs(y8 - y32).max())
+    assert err < 0.15 * rms, (err, rms)
+
+
+def test_fp8_delayed_scaling_state_updates():
+    x = jnp.full((4, 8), 2.0)
+    w = jnp.full((4, 8), 0.5)
+    state = init_fp8_dense_state(history_len=4)
+    _, s1 = fp8_fused_dense(x, w, None, state)
+    # history rolled: newest amax at slot 0
+    np.testing.assert_allclose(float(s1.x.amax_history[0]), 2.0)
+    np.testing.assert_allclose(float(s1.w.amax_history[0]), 0.5)
+    # delayed: the NEXT scale derives from the updated history max
+    np.testing.assert_allclose(float(s1.x.scale), FP8_E4M3_MAX / 2.0)
+    np.testing.assert_allclose(float(s1.w.scale), FP8_E4M3_MAX / 0.5)
+    # a smaller step keeps the history max (window semantics)
+    _, s2 = fp8_fused_dense(x * 0.1, w, None, s1)
+    np.testing.assert_allclose(float(s2.x.scale), FP8_E4M3_MAX / 2.0)
+    # after the big value ages out of the window, the scale tightens
+    s = s2
+    for _ in range(4):
+        _, s = fp8_fused_dense(x * 0.1, w, None, s)
+    np.testing.assert_allclose(float(s.x.scale), FP8_E4M3_MAX / 0.2)
+
+
+def test_fp8_dense_grads_flow_high_precision():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (16, 32), jnp.float32)
+    w = jax.random.normal(k2, (8, 32), jnp.float32) * 0.1
+    state = init_fp8_dense_state()
+    _, state = fp8_fused_dense(x, w, None, state)
+
+    def loss8(x, w):
+        y, _ = fp8_fused_dense(x, w, None, state)
+        return jnp.sum(y ** 2)
+
+    def loss32(x, w):
+        return jnp.sum(fused_dense(x, w, jnp.zeros((8,))) ** 2)
+
+    g8 = jax.grad(loss8, argnums=(0, 1))(x, w)
+    g32 = jax.grad(loss32, argnums=(0, 1))(x, w)
+    for a, b in zip(g8, g32):
+        assert jnp.all(jnp.isfinite(a))
+        # bwd runs in fp32 on the exact x/w; the only divergence is the
+        # quantized forward feeding dy magnitudes — expect close-not-equal
+        rel = float(jnp.abs(a - b).max() / jnp.abs(b).max())
+        assert rel < 0.1, rel
+
+
+def test_fp8_amax_reduction_inside_shard_map():
+    parallel_state.initialize_model_parallel(1, 1, use_fp8_=True)
+    parallel_state.destroy_model_parallel()
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "tensor"))
+    x = jnp.arange(32.0).reshape(8, 4)  # max 31 on the last data shard
+    w = jnp.ones((4, 4))
+
+    def local(x, w):
+        state = init_fp8_dense_state(history_len=2)
+        _, new_state = fp8_fused_dense(
+            x, w, None, state, amax_reduction_axes=("data", "tensor"))
+        return new_state.x.amax_history[0]
+
+    out = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P("data", None), P()),
+        out_specs=P(), check_vma=False,
+    ))(x, w)
+    # every rank must report the GLOBAL amax
+    np.testing.assert_allclose(float(out), 31.0)
