@@ -4,6 +4,7 @@ from .permutation_search import (
     apply_permutation_C,
     apply_permutation_K,
     channel_swap_search,
+    exhaustive_search,
     sum_after_2_to_4,
 )
 from .sparse_masklib import create_mask
@@ -12,6 +13,7 @@ __all__ = [
     "ASP",
     "create_mask",
     "channel_swap_search",
+    "exhaustive_search",
     "apply_2_to_4",
     "sum_after_2_to_4",
     "apply_permutation_C",

@@ -93,6 +93,11 @@ def _best_swap(matrix: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
 
 
 @jax.jit
+def _permute_cols(matrix, cols, new_cols):
+    return matrix.at[:, cols].set(matrix[:, new_cols])
+
+
+@jax.jit
 def _swap_cols(matrix, a, b):
     ca = matrix[:, a]
     cb = matrix[:, b]
@@ -140,6 +145,168 @@ def channel_swap_search(
             sub, c, (2,), replace=False))
         m = _swap_cols(m, a, b)
         perm[[a, b]] = perm[[b, a]]
+    kept = float(sum_after_2_to_4(m))
+    if kept > best[1]:
+        best = (perm.copy(), kept)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Exhaustive within-window search (reference Exhaustive_Search,
+# ``permutation_search_kernels/exhaustive_search.py:104-230``): slide a
+# window of ``window_size`` columns (= window_size/4 stripes) over all
+# stripe combinations, try EVERY unique column-to-group assignment inside
+# the window (35 for a 2-stripe window, 5775 for 3), greedily apply the
+# best window repermutation until no window improves, with random escape
+# moves out of local optima. The reference evaluates candidate
+# permutations in a CUDA kernel grid; here one vmapped top-2 reduction
+# scores all (window, permutation) candidates as a single batched tensor
+# op, chunked over windows with lax.map.
+# ---------------------------------------------------------------------------
+
+_CANONICAL_PERMS_CACHE: dict = {}
+
+
+def _canonical_group_perms(n_cols: int, group_width: int = 4) -> np.ndarray:
+    """All unique assignments of ``n_cols`` columns into groups of
+    ``group_width`` (sorted within groups, groups sorted by first member
+    — the reference's canonical form, ``exhaustive_search.py:19-31``).
+    (8, 4) -> 35, (12, 4) -> 5775."""
+    key_ = (n_cols, group_width)
+    if key_ in _CANONICAL_PERMS_CACHE:
+        return _CANONICAL_PERMS_CACHE[key_]
+    out = []
+
+    def build(perm, remaining):
+        if not remaining:
+            out.append(list(perm))
+            return
+        for i, col in enumerate(remaining):
+            if len(perm) % group_width == 0:
+                if any(v < col for v in remaining[:i]):
+                    continue
+                if perm and col <= perm[-group_width]:
+                    continue
+            elif col <= perm[-1]:
+                continue
+            build(perm + [col], remaining[:i] + remaining[i + 1:])
+
+    build([], list(range(n_cols)))
+    arr = np.asarray(out, np.int32)
+    _CANONICAL_PERMS_CACHE[key_] = arr
+    return arr
+
+
+def _window_kept(matrix: jax.Array, window_cols: jax.Array,
+                 perms: jax.Array) -> jax.Array:
+    """[P, M] kept magnitude of window ``p`` under candidate ``m``.
+    ``window_cols`` [P, W] column indices; ``perms`` [M, W]."""
+    def per_window(cols):  # [W] -> [M]
+        win = matrix[:, cols]  # [R, W]
+        cand = win[:, perms]  # [R, M, W]
+        cand = jnp.moveaxis(cand, 1, 0)  # [M, R, W]
+        g = jnp.abs(cand).reshape(cand.shape[0], cand.shape[1], -1, 4)
+        small2 = jnp.sum(jnp.sort(g, axis=-1)[..., :2], axis=-1)
+        return jnp.sum(jnp.sum(g, axis=-1) - small2, axis=(1, 2))
+    return jax.lax.map(per_window, window_cols)
+
+
+@jax.jit
+def _best_window_move(matrix, window_cols, perms):
+    kept = _window_kept(matrix, window_cols, perms)  # [P, M]
+    base = kept[:, 0]  # perm 0 is the identity (canonical order)
+    gain = kept - base[:, None]
+    flat = jnp.argmax(gain)
+    p, m = flat // gain.shape[1], flat % gain.shape[1]
+    return gain[p, m], p, m
+
+
+def exhaustive_search(
+    matrix,
+    escape_attempts: int = 10,
+    window_size: int = 8,
+    key: Optional[jax.Array] = None,
+    max_iters: int = 1000,
+    min_gain: float = 1e-6,
+    initial_permutation=None,
+) -> Tuple[np.ndarray, float]:
+    """Windowed exhaustive permutation search with escape moves; same
+    ``(permutation, kept_magnitude)`` contract as
+    :func:`channel_swap_search`. Every window move considers ALL unique
+    reassignments of ``window_size`` columns at once (a single swap is
+    one of the candidates), alternated with cross-window swap polish, and
+    escape moves restart from randomized windows keeping the best-seen
+    permutation. ``initial_permutation`` warm-starts the search (the
+    reference's searches accept a ``permutation=`` the same way) — e.g.
+    from :func:`channel_swap_search`'s result, which the warm-started
+    search can only improve on."""
+    m = jnp.asarray(matrix, jnp.float32)
+    r, c = m.shape
+    if c % 4:
+        raise ValueError(f"columns {c} must be a multiple of 4")
+    if window_size % 4 or window_size < 8:
+        raise ValueError(f"window_size {window_size} must be a multiple "
+                         "of 4 and >= 8")
+    s = c // 4
+    w_stripes = window_size // 4
+    if escape_attempts > 0 and key is None and s >= w_stripes:
+        raise ValueError("escape_attempts > 0 requires key")
+    if s < w_stripes:
+        # matrix smaller than one window: nothing to search, but the
+        # warm start (if any) is still the result being reported
+        perm = (np.arange(c) if initial_permutation is None
+                else np.asarray(initial_permutation, np.int64).copy())
+        return perm, float(sum_after_2_to_4(m[:, jnp.asarray(perm)]))
+
+    import itertools
+
+    stripe_groups = np.asarray(
+        list(itertools.combinations(range(s), w_stripes)), np.int32)
+    window_cols = jnp.asarray(
+        (stripe_groups[:, :, None] * 4
+         + np.arange(4)[None, None, :]).reshape(len(stripe_groups), -1))
+    perms = jnp.asarray(_canonical_group_perms(window_size))
+
+    perm = np.arange(c)
+    if initial_permutation is not None:
+        perm = np.asarray(initial_permutation, np.int64).copy()
+        m = m[:, jnp.asarray(perm)]
+    best = (perm.copy(), float(sum_after_2_to_4(m)))
+    escapes_left = escape_attempts
+
+    def apply_window(m, perm, p, mi):
+        cols = np.asarray(window_cols[int(p)])
+        new_cols = cols[np.asarray(perms[int(mi)])]
+        m = _permute_cols(m, jnp.asarray(cols), jnp.asarray(new_cols))
+        perm[cols] = perm[new_cols]
+        return m, perm
+
+    for _ in range(max_iters):
+        # phase 1: best exhaustive window move (a single swap is one of
+        # the candidate regroupings, so per-move this dominates greedy)
+        gain, p, mi = _best_window_move(m, window_cols, perms)
+        if float(gain) > min_gain:
+            m, perm = apply_window(m, perm, p, mi)
+            continue
+        # phase 2 (polish): cross-window single swaps reach column pairs
+        # whose stripes the window move just rearranged — alternating the
+        # two move sets converges to a local optimum of BOTH
+        gain, a, b = _best_swap(m)
+        if float(gain) > min_gain:
+            a, b = int(a), int(b)
+            m = _swap_cols(m, a, b)
+            perm[[a, b]] = perm[[b, a]]
+            continue
+        kept = float(sum_after_2_to_4(m))
+        if kept > best[1]:
+            best = (perm.copy(), kept)
+        if escapes_left <= 0:
+            break
+        escapes_left -= 1
+        key, k1, k2 = jax.random.split(key, 3)
+        p = int(jax.random.randint(k1, (), 0, len(stripe_groups)))
+        mi = int(jax.random.randint(k2, (), 1, perms.shape[0]))
+        m, perm = apply_window(m, perm, p, mi)
     kept = float(sum_after_2_to_4(m))
     if kept > best[1]:
         best = (perm.copy(), kept)

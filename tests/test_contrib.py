@@ -434,3 +434,78 @@ def test_permutation_C_K_pair_preserves_composition():
     y = W2 @ (W1 @ x)
     y_perm = apply_permutation_C(W2, perm) @ (apply_permutation_K(W1, perm) @ x)
     assert jnp.abs(y - y_perm).max() < 1e-4
+
+
+def test_exhaustive_search_canonical_perm_counts():
+    """The unique-combination generator matches the reference's counts
+    (exhaustive_search.py: 35 for 8 cols / width 4, 5775 for 12)."""
+    from apex_tpu.contrib.sparsity.permutation_search import (
+        _canonical_group_perms,
+    )
+
+    p8 = _canonical_group_perms(8)
+    assert p8.shape == (35, 8)
+    # first entry is the identity (greedy gain baseline relies on it)
+    np.testing.assert_array_equal(p8[0], np.arange(8))
+    assert _canonical_group_perms(12).shape == (5775, 12)
+
+
+def test_exhaustive_search_finds_global_optimum_single_window():
+    """With c == window_size the search IS a global exhaustive search:
+    check against direct enumeration of all 35 assignments."""
+    from apex_tpu.contrib.sparsity.permutation_search import (
+        _canonical_group_perms,
+        exhaustive_search,
+        sum_after_2_to_4,
+    )
+
+    m = np.random.default_rng(42).normal(size=(8, 8)).astype(np.float32)
+    perm, kept = exhaustive_search(m, escape_attempts=0)
+    best = max(
+        float(sum_after_2_to_4(jnp.asarray(m)[:, p]))
+        for p in _canonical_group_perms(8)
+    )
+    np.testing.assert_allclose(kept, best, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(sum_after_2_to_4(jnp.asarray(m)[:, perm])), kept, rtol=1e-6)
+
+
+def test_exhaustive_search_beats_greedy_on_seeded_cases():
+    """VERDICT round-3 item 6 done-criterion: warm-started from the greedy
+    channel-swap result, the exhaustive window search never loses and
+    strictly improves on several seeds."""
+    from apex_tpu.contrib.sparsity import (
+        channel_swap_search,
+        exhaustive_search,
+        sum_after_2_to_4,
+    )
+
+    strict_wins = 0
+    for seed in range(8):
+        m = np.random.default_rng(seed).normal(size=(16, 16)).astype(
+            np.float32)
+        pg, kg = channel_swap_search(np.asarray(m), max_iters=200)
+        pe, ke = exhaustive_search(
+            m, escape_attempts=4, key=jax.random.PRNGKey(seed),
+            initial_permutation=pg,
+        )
+        # the reported kept is achieved by the returned permutation
+        np.testing.assert_allclose(
+            float(sum_after_2_to_4(jnp.asarray(m)[:, pe])), ke, rtol=1e-5)
+        assert ke >= kg - 1e-4, (seed, kg, ke)
+        strict_wins += ke > kg + 1e-4
+    assert strict_wins >= 2, strict_wins
+
+
+def test_exhaustive_search_validation_and_small_inputs():
+    from apex_tpu.contrib.sparsity import exhaustive_search
+
+    with pytest.raises(ValueError, match="multiple"):
+        exhaustive_search(np.ones((4, 6)))
+    with pytest.raises(ValueError, match="window_size"):
+        exhaustive_search(np.ones((4, 8)), window_size=6)
+    with pytest.raises(ValueError, match="requires key"):
+        exhaustive_search(np.ones((4, 16)), escape_attempts=2)
+    # fewer stripes than the window: identity, no search
+    perm, kept = exhaustive_search(np.ones((4, 4)), escape_attempts=0)
+    np.testing.assert_array_equal(perm, np.arange(4))
