@@ -41,6 +41,8 @@ def parse_args(
     _add_validation_args(parser)
     _add_data_args(parser)
     _add_autoresume_args(parser)
+    _add_biencoder_args(parser)
+    _add_vision_args(parser)
     _add_inference_args(parser)
     if extra_args_provider is not None:
         parser = extra_args_provider(parser)
@@ -60,6 +62,36 @@ def parse_args(
 
 def validate_args(args):
     """Consistency checks mirroring reference ``arguments.py`` validation."""
+    # Deprecated arguments (reference :105-131): hard errors for the
+    # removed spellings, silent upgrades for the recompute shorthands.
+    if args.batch_size is not None:
+        raise ValueError(
+            "--batch-size argument is no longer valid, use "
+            "--micro-batch-size instead")
+    del args.batch_size
+    if args.warmup is not None:
+        raise ValueError(
+            "--warmup argument is no longer valid, use "
+            "--lr-warmup-fraction instead")
+    del args.warmup
+    if args.model_parallel_size is not None:
+        raise ValueError(
+            "--model-parallel-size is no longer valid, use "
+            "--tensor-model-parallel-size instead")
+    del args.model_parallel_size
+    if args.checkpoint_activations:
+        args.recompute_granularity = "full"
+        args.recompute_method = "uniform"
+    del args.checkpoint_activations
+    if args.recompute_activations:
+        args.recompute_granularity = "selective"
+    del args.recompute_activations
+    if args.local_rank_underscore is not None:
+        # torch.distributed.launch passes --local_rank; fold into the
+        # canonical spelling
+        args.local_rank = args.local_rank_underscore
+    del args.local_rank_underscore
+
     world = args.world_size or len(jax.devices())
     args.world_size = world
     model_parallel = (
@@ -113,6 +145,22 @@ def validate_args(args):
     if args.sequence_parallel and args.tensor_model_parallel_size == 1:
         # SP without TP is a no-op; the reference asserts similarly
         args.sequence_parallel = False
+    if args.num_layers_per_virtual_pipeline_stage is not None:
+        if args.pipeline_model_parallel_size <= 2:
+            raise ValueError(
+                "pipeline-model-parallel size should be greater than 2 "
+                "with interleaved schedule")
+        if args.num_layers is None:
+            raise ValueError(
+                "--num-layers-per-virtual-pipeline-stage requires "
+                "--num-layers")
+        if args.num_layers % args.num_layers_per_virtual_pipeline_stage:
+            raise ValueError(
+                "number of layers is not divisible by number of layers "
+                "per virtual pipeline stage")
+        args.virtual_pipeline_model_parallel_size = (
+            (args.num_layers // args.pipeline_model_parallel_size)
+            // args.num_layers_per_virtual_pipeline_stage)
     if (
         args.virtual_pipeline_model_parallel_size is not None
         and args.pipeline_model_parallel_size <= 2
@@ -120,6 +168,12 @@ def validate_args(args):
         raise ValueError(
             "interleaved schedule requires pipeline size > 2"
         )
+    if args.pipeline_model_parallel_split_rank is not None:
+        if not (args.pipeline_model_parallel_split_rank
+                < args.pipeline_model_parallel_size):
+            raise ValueError(
+                "split rank needs to be less than pipeline model parallel "
+                f"size ({args.pipeline_model_parallel_size})")
     if args.recompute_method is not None and args.recompute_granularity != "full":
         raise ValueError(
             "--recompute-method is only meaningful with "
@@ -160,9 +214,14 @@ def _add_network_size_args(parser):
                        action="store_true")
     group.add_argument("--openai-gelu", action="store_true")
     group.add_argument("--onnx-safe", type=bool, default=None)
+    group.add_argument("--num-experts", type=int, default=None,
+                       help="Number of MoE experts (reference :395)")
     group.add_argument("--bert-binary-head", action="store_true", default=True)
     group.add_argument("--no-bert-binary-head", action="store_false",
                        dest="bert_binary_head")
+    group.add_argument("--bert-no-binary-head", action="store_false",
+                       dest="bert_binary_head",
+                       help="the reference's spelling of the same toggle")
     return parser
 
 
@@ -181,6 +240,15 @@ def _add_logging_args(parser):
     group.add_argument("--log-validation-ppl-to-tensorboard",
                        action="store_true")
     group.add_argument("--log-memory-to-tensorboard", action="store_true")
+    group.add_argument("--log-batch-size-to-tensorboard", action="store_true")
+    group.add_argument("--log-world-size-to-tensorboard", action="store_true")
+    # the reference's (sic) spelling — command-line parity demands it
+    group.add_argument("--no-log-learnig-rate-to-tensorboard",
+                       action="store_false",
+                       dest="log_learning_rate_to_tensorboard")
+    group.add_argument("--no-log-loss-scale-to-tensorboard",
+                       action="store_false",
+                       dest="log_loss_scale_to_tensorboard")
     group.add_argument("--log-interval", type=int, default=100)
     return parser
 
@@ -206,6 +274,8 @@ def _add_regularization_args(parser):
 def _add_training_args(parser):
     group = parser.add_argument_group(title="training")
     group.add_argument("--micro-batch-size", type=int, default=None)
+    group.add_argument("--batch-size", type=int, default=None,
+                       help="deprecated; use --micro-batch-size")
     group.add_argument("--global-batch-size", type=int, default=None)
     group.add_argument("--rampup-batch-size", nargs="*", default=None)
     group.add_argument("--train-iters", type=int, default=None)
@@ -218,6 +288,12 @@ def _add_training_args(parser):
         "--recompute-granularity", type=str, default=None,
         choices=["full", "selective"],
     )
+    group.add_argument("--recompute-activations", action="store_true",
+                       help="shorthand for --recompute-granularity "
+                       "selective (reference :502)")
+    group.add_argument("--distribute-saved-activations", action="store_true",
+                       help="distribute recomputed activations across the "
+                       "model parallel group (reference :513)")
     group.add_argument("--recompute-method", type=str, default=None,
                        choices=["uniform", "block"])
     group.add_argument("--recompute-num-layers", type=int, default=1)
@@ -242,6 +318,9 @@ def _add_training_args(parser):
                        dest="bias_dropout_fusion")
     group.add_argument("--empty-unused-memory-level", type=int, default=0,
                        choices=range(0, 3))
+    group.add_argument("--checkpoint-activations", action="store_true",
+                       help="deprecated; upgraded to --recompute-granularity "
+                       "full --recompute-method uniform (reference :115-121)")
     return parser
 
 
@@ -263,6 +342,8 @@ def _add_learning_rate_args(parser):
     group.add_argument("--lr-warmup-fraction", type=float, default=None)
     group.add_argument("--lr-warmup-iters", type=int, default=0)
     group.add_argument("--lr-warmup-samples", type=int, default=0)
+    group.add_argument("--warmup", type=int, default=None,
+                       help="deprecated; use --lr-warmup-fraction")
     group.add_argument("--min-lr", type=float, default=0.0)
     group.add_argument("--override-lr-scheduler", action="store_true")
     group.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
@@ -311,6 +392,20 @@ def _add_distributed_args(parser):
     group.add_argument(
         "--pipeline-model-parallel-split-rank", type=int, default=None
     )
+    group.add_argument("--model-parallel-size", type=int, default=None,
+                       help="deprecated; use --tensor-model-parallel-size")
+    group.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
+                       default=None)
+    group.add_argument("--no-contiguous-buffers-in-local-ddp",
+                       action="store_false",
+                       dest="use_contiguous_buffers_in_local_ddp")
+    group.add_argument("--no-scatter-gather-tensors-in-pipeline",
+                       action="store_false",
+                       dest="scatter_gather_tensors_in_pipeline")
+    group.add_argument("--local_rank", type=int, default=None,
+                       dest="local_rank_underscore",
+                       help="torch.distributed.launch spelling; folded into "
+                       "--local-rank by validate_args")
     group.add_argument("--world-size", type=int, default=None)
     group.add_argument("--rank", type=int, default=0)
     group.add_argument("--local-rank", type=int, default=0)
@@ -351,6 +446,10 @@ def _add_data_args(parser):
     group.add_argument("--tokenizer-type", type=str, default=None,
                        choices=["BertWordPieceLowerCase",
                                 "BertWordPieceCase", "GPT2BPETokenizer"])
+    group.add_argument("--data-impl", type=str, default="infer",
+                       choices=["lazy", "cached", "mmap", "infer"])
+    group.add_argument("--vocab-extra-ids", type=int, default=0)
+    group.add_argument("--sample-rate", type=float, default=1.0)
     group.add_argument("--reset-position-ids", action="store_true")
     group.add_argument("--reset-attention-mask", action="store_true")
     group.add_argument("--eod-mask-loss", action="store_true")
@@ -361,4 +460,66 @@ def _add_autoresume_args(parser):
     group = parser.add_argument_group(title="autoresume")
     group.add_argument("--adlr-autoresume", action="store_true")
     group.add_argument("--adlr-autoresume-interval", type=int, default=1000)
+    return parser
+
+
+def _add_biencoder_args(parser):
+    """Reference ``:854-909`` — ICT/REALM biencoder + retriever/indexer."""
+    group = parser.add_argument_group(title="biencoder")
+    group.add_argument("--ict-head-size", type=int, default=None)
+    group.add_argument("--biencoder-projection-dim", type=int, default=0)
+    group.add_argument("--biencoder-shared-query-context-model",
+                       action="store_true")
+    group.add_argument("--ict-load", type=str, default=None)
+    group.add_argument("--bert-load", type=str, default=None)
+    group.add_argument("--titles-data-path", type=str, default=None)
+    group.add_argument("--query-in-block-prob", type=float, default=0.1)
+    group.add_argument("--use-one-sent-docs", action="store_true")
+    group.add_argument("--evidence-data-path", type=str, default=None)
+    group.add_argument("--retriever-report-topk-accuracies", nargs="+",
+                       type=int, default=[])
+    group.add_argument("--retriever-score-scaling", action="store_true")
+    group.add_argument("--block-data-path", type=str, default=None)
+    group.add_argument("--embedding-path", type=str, default=None)
+    group.add_argument("--indexer-batch-size", type=int, default=128)
+    group.add_argument("--indexer-log-interval", type=int, default=1000)
+    return parser
+
+
+def _add_vision_args(parser):
+    """Reference ``:911-977`` — ViT/Swin/MiT classification, inpainting,
+    DINO self-supervision."""
+    group = parser.add_argument_group(title="vision")
+    group.add_argument("--num-classes", type=int, default=1000)
+    group.add_argument("--img-h", type=int, default=224)
+    group.add_argument("--img-w", type=int, default=224)
+    group.add_argument("--num-channels", type=int, default=3)
+    group.add_argument("--patch-dim", type=int, default=16)
+    group.add_argument("--classes-fraction", type=float, default=1.0)
+    group.add_argument("--data-per-class-fraction", type=float, default=1.0)
+    group.add_argument("--no-data-sharding", action="store_false",
+                       dest="data_sharding")
+    group.add_argument("--head-lr-mult", type=float, default=1.0)
+    group.add_argument("--vision-pretraining", action="store_true")
+    group.add_argument("--vision-pretraining-type", type=str,
+                       default="classify",
+                       choices=["classify", "inpaint", "dino"])
+    group.add_argument("--vision-backbone-type", type=str, default="vit",
+                       choices=["vit", "mit", "swin"])
+    group.add_argument("--swin-backbone-type", type=str, default="tiny",
+                       choices=["tiny", "base", "h3"])
+    group.add_argument("--mask-type", type=str, default="random",
+                       choices=["random", "row"])
+    group.add_argument("--mask-factor", type=float, default=1.0)
+    group.add_argument("--iter-per-epoch", type=int, default=1250)
+    group.add_argument("--dino-local-img-size", type=int, default=96)
+    group.add_argument("--dino-local-crops-number", type=int, default=10)
+    group.add_argument("--dino-head-hidden-size", type=int, default=2048)
+    group.add_argument("--dino-bottleneck-size", type=int, default=256)
+    group.add_argument("--dino-freeze-last-layer", type=float, default=1)
+    group.add_argument("--dino-norm-last-layer", action="store_true")
+    group.add_argument("--dino-warmup-teacher-temp", type=float, default=0.04)
+    group.add_argument("--dino-teacher-temp", type=float, default=0.07)
+    group.add_argument("--dino-warmup-teacher-temp-epochs", type=int,
+                       default=30)
     return parser
