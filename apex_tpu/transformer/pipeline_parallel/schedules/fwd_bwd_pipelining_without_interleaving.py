@@ -180,7 +180,11 @@ def pipeline_rounds(
     # the scan. Residuals: O(total/K) boundary states; recompute: each
     # tick's forward replays in backward (twice with checkpoint_stages).
     # Padding ticks (K not dividing total) recompute clipped indices
-    # harmlessly with is_out masked off.
+    # harmlessly with is_out masked off. NB the emission machinery itself
+    # carries ~2x the [n, ...] output rows through the outer scan, so the
+    # net win needs the ring states to dominate — i.e. vpp > 2 or large
+    # per-tick states (pinned by tests/test_pipeline_1f1b.py's
+    # memory_analysis assertion at vpp=4: ~5x lower peak temp).
     k = int(tick_checkpoint)
     if k <= 0:
         raise ValueError(f"tick_checkpoint must be positive, got {k}")
