@@ -230,23 +230,33 @@ def _scaled_q(q_ref, scale):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, bias_ref, mask_ref, segq_ref, segk_ref, seed_ref,
-    o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k, n_k, n_heads, have_bias, have_mask,
+    o_ref, lse_ref, *scratch,
+    scale, causal, block_q, block_k, n_k, n_heads, have_bias, have_mask,
     have_segs, dropout_p,
 ):
     ib, ih = pl.program_id(0), pl.program_id(1)
     iq, ik = pl.program_id(2), pl.program_id(3)
+    # single-k-block fast path: every (iq) sees its whole key range in one
+    # tile, so the online-softmax recurrence (scratch buffers, running
+    # m/l, alpha rescale, deferred finish) collapses to one direct
+    # softmax — _fwd passes NO scratch in that case
+    single = n_k == 1
+    if not single:
+        m_scr, l_scr, acc_scr = scratch
 
-    @pl.when(ik == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        @pl.when(ik == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def compute():
-        # dots run in the INPUT dtype with fp32 accumulation — bf16 inputs
-        # hit the MXU's native rate; upcasting first would force the slow
-        # fp32 matmul path. The softmax scale rides in with q (_scaled_q)
+    def score_tile():
+        """Shared prologue: scaled q @ k.T + bias + masking — one
+        implementation for both paths so the score/mask semantics cannot
+        desynchronise (probs()/dropped() below are likewise shared)."""
+        # dots run in the INPUT dtype with fp32 accumulation — bf16
+        # inputs hit the MXU's native rate; upcasting first would force
+        # the slow fp32 matmul path. The softmax scale rides in with q.
         q = _scaled_q(q_ref, scale)
         k = k_ref[0, 0]  # [bk, d]
         s = jax.lax.dot_general(
@@ -255,41 +265,65 @@ def _fwd_kernel(
         )  # [bq, bk]
         if have_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
-
         qi, ki = _tile_indices(iq, ik, block_q, block_k)
         s = _mask_scores(
             s, qi, ki, causal=causal, have_mask=have_mask, mask_ref=mask_ref,
             have_segs=have_segs, segq_ref=segq_ref, segk_ref=segk_ref,
         )
+        return s, qi, ki
 
-        m_prev = m_scr[:, :1]  # [bq, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+    def probs(s, m):
+        """exp(s - m) with the fully-masked-row guard: a masked tile (or
+        a bias row folded to -1e30) must contribute exactly zero; on the
+        pure-causal/unmasked hot path the -1e30 entries underflow exp to
+        exact 0 already, so the extra [bq, bk] pass is skipped."""
+        p = jnp.exp(s - m)
         if have_mask or have_segs or have_bias:
-            # guard fully-masked rows: exp(-inf - -inf) -> 0 contribution
-            # (a bias row folded to -1e30 can fully mask too). Pure-causal/
-            # unmasked tiles never produce a fully-masked row, and their
-            # -1e30 entries underflow exp to exact 0 already — skip the
-            # two extra [bq, bk] VPU passes on that hot path.
             p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-            alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+        return p
 
-        # softmax normalizer uses the UNDROPPED probabilities; dropout hits
-        # only the value accumulation (standard attention-dropout semantics:
-        # out = dropout(softmax(s)) @ v)
-        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        p_acc = p
-        if dropout_p > 0.0:
-            bh = ib * n_heads + ih
-            keep = _keep_mask(seed_ref[0], bh, qi, ki, dropout_p)
-            p_acc = p * keep * (1.0 / (1.0 - dropout_p))
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+    def dropped(p, qi, ki):
+        # softmax normalizer uses the UNDROPPED probabilities; dropout
+        # hits only the value accumulation (standard attention-dropout
+        # semantics: out = dropout(softmax(s)) @ v)
+        if dropout_p == 0.0:
+            return p
+        bh = ib * n_heads + ih
+        keep = _keep_mask(seed_ref[0], bh, qi, ki, dropout_p)
+        return p * keep * (1.0 / (1.0 - dropout_p))
+
+    def pv(p_acc):
+        return jax.lax.dot_general(
             p_acc.astype(v_ref.dtype), v_ref[0, 0],
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    def write_out(acc, m, l):
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(safe_l))
+
+    if single:
+        # with n_k == 1 the (causal) tile skip never fires: ik == 0
+        # always intersects the diagonal band of every q block
+        s, qi, ki = score_tile()
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = probs(s, m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        write_out(pv(dropped(p, qi, ki)), m, l)
+        return
+
+    def compute():
+        s, qi, ki = score_tile()
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = probs(s, m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        if have_mask or have_segs or have_bias:
+            alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + pv(dropped(p, qi, ki))
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -303,11 +337,7 @@ def _fwd_kernel(
 
     @pl.when(ik == n_k - 1)
     def _finish():
-        l = l_scr[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        m = m_scr[:, :1]
-        lse_ref[0, 0] = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(safe_l))
+        write_out(acc_scr[:], m_scr[:, :1], l_scr[:, :1])
 
 
 def _seg_args(segments, s):
@@ -408,7 +438,10 @@ def _fwd(
         _sds((b, n, s_q, 1), jnp.float32, q, k, v, bias_arg, mask_arg,
              segq_arg, segk_arg, seed_arg),
     ]
-    scratch = [
+    # the single-k-block fast path (n_k == 1) runs a direct softmax with
+    # NO recurrence scratch — keep that ~1.25 MB of VMEM per program free
+    # for the data tiles
+    scratch = [] if n_k == 1 else [
         pltpu.VMEM((bq, 128), jnp.float32),
         pltpu.VMEM((bq, 128), jnp.float32),
         pltpu.VMEM((bq, d), jnp.float32),
