@@ -244,3 +244,50 @@ def test_tick_checkpoint_memory_claim(pp_mesh):
     chunked = temp_bytes(32, 16)
     # measured ~2.4 MB vs ~0.5 MB on the CPU harness; require a decisive cut
     assert chunked < plain / 2, (chunked, plain)
+
+
+def test_1f1b_with_flash_attention_stage(pp_mesh):
+    """1F1B stores flattened jax.vjp closures in its ring buffer; a stage
+    containing the Pallas flash kernel (a custom_vjp primitive) must
+    flatten/unflatten cleanly and still match dense grads."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    pl = parallel_state.PIPELINE_AXIS
+    B, NH, S, D = 2, 2, 16, 8
+
+    def attn_stage(lp, x):  # x [B, NH, S, D]
+        q = jnp.einsum("bnsd,de->bnse", x, lp["wq"])
+        o = flash_attention(
+            q, x, x, causal=True, interpret=True, block_q=8, block_k=8)
+        return x + o.astype(x.dtype)
+
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    params = {"wq": jax.random.normal(ks[0], (PP, D, D)) * 0.5}
+    n = 6
+    inputs = jax.random.normal(ks[1], (n, B, NH, S, D))
+    targets = jax.random.normal(ks[2], (n, B, NH, S, D))
+    pspec = {"wq": P(pl, None, None)}
+
+    def local_fn(stage_p, inputs, targets):
+        loss, grads, _ = pipeline_forward_backward_1f1b(
+            attn_stage, _loss_fn, stage_p, inputs, targets,
+            axis_name=pl, with_dinputs=False,
+        )
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = _jit_pipeline(pp_mesh, local_fn, pspec)(
+        params, inputs, targets)
+
+    def dense(params):
+        total = 0.0
+        for m in range(n):
+            h = inputs[m]
+            for s in range(PP):
+                h = attn_stage({"wq": params["wq"][s]}, h)
+            total = total + _loss_fn(h, targets[m])
+        return total / n
+
+    ref_loss, ref_grads = jax.value_and_grad(dense)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(grads["wq"]), np.asarray(ref_grads["wq"]), atol=5e-4)
