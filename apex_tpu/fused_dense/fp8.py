@@ -69,19 +69,22 @@ def init_fp8_dense_state(
     )
 
 
-def quantize_e4m3(x: jax.Array, scale: jax.Array) -> jax.Array:
-    """Scale, saturate to the e4m3 range, cast."""
+def _quantize(x, scale, fp8_max, dtype):
+    """Scale, saturate to the format's range, cast — one implementation
+    so the quantization convention cannot diverge between formats."""
     xs = x.astype(jnp.float32) * scale
-    xs = jnp.clip(xs, -FP8_E4M3_MAX, FP8_E4M3_MAX)
-    return xs.astype(jnp.float8_e4m3fn)
+    return jnp.clip(xs, -fp8_max, fp8_max).astype(dtype)
+
+
+def quantize_e4m3(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """e4m3: the activation/weight format."""
+    return _quantize(x, scale, FP8_E4M3_MAX, jnp.float8_e4m3fn)
 
 
 def quantize_e5m2(x: jax.Array, scale: jax.Array) -> jax.Array:
-    """Scale, saturate to the e5m2 range, cast — the gradient format (TE
-    recipe: wide exponent for the long dynamic-range tail of dY)."""
-    xs = x.astype(jnp.float32) * scale
-    xs = jnp.clip(xs, -FP8_E5M2_MAX, FP8_E5M2_MAX)
-    return xs.astype(jnp.float8_e5m2)
+    """e5m2: the gradient format (TE recipe: wide exponent for the long
+    dynamic-range tail of dY)."""
+    return _quantize(x, scale, FP8_E5M2_MAX, jnp.float8_e5m2)
 
 
 def _updated_meta(meta: Fp8TensorMeta, amax_now: jax.Array,
@@ -130,17 +133,22 @@ def _fp8_matmul_fwd(x, w, scale_x, scale_w):
     return _fp8_matmul(x, w, scale_x, scale_w), (x, w)
 
 
-def _fp8_matmul_bwd(res, dy):
-    # straight-through: dgrad/wgrad in the input precision (TE's
-    # conservative recipe half; e5m2 grad quantization would slot in here)
-    x, w = res
-    dyf = dy.astype(jnp.float32)
+def _dgrad_wgrad(x, w, dyf):
+    """fp32 dgrad/wgrad shared by both backward flavors."""
     dx = jnp.einsum(
         "...o,oi->...i", dyf, w.astype(jnp.float32)
     ).astype(x.dtype)
     dw = jnp.einsum(
         "...o,...i->oi", dyf, x.astype(jnp.float32)
     ).astype(w.dtype)
+    return dx, dw
+
+
+def _fp8_matmul_bwd(res, dy):
+    # straight-through: dgrad/wgrad in the input precision (TE's
+    # conservative recipe half; _fp8_matmul_qgrad is the e5m2 version)
+    x, w = res
+    dx, dw = _dgrad_wgrad(x, w, dy.astype(jnp.float32))
     return dx, dw, None, None
 
 
@@ -167,13 +175,7 @@ def _fp8_matmul_qgrad_bwd(res, dy):
     x, w, scale_g = res
     amax_g = jnp.max(jnp.abs(dy)).astype(jnp.float32)
     qdy = quantize_e5m2(dy, scale_g)
-    dyf = qdy.astype(jnp.float32) / scale_g
-    dx = jnp.einsum(
-        "...o,oi->...i", dyf, w.astype(jnp.float32)
-    ).astype(x.dtype)
-    dw = jnp.einsum(
-        "...o,...i->oi", dyf, x.astype(jnp.float32)
-    ).astype(w.dtype)
+    dx, dw = _dgrad_wgrad(x, w, qdy.astype(jnp.float32) / scale_g)
     return dx, dw, None, None, None, amax_g
 
 

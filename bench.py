@@ -45,6 +45,20 @@ import os
 import jax
 import jax.numpy as jnp
 
+# persistent XLA compilation cache: the fully-unrolled 345M step costs
+# minutes of compile; cached executables make repeat bench runs (and the
+# driver's) start in seconds. Opt out with APEX_TPU_NO_COMPILE_CACHE=1.
+if os.environ.get("APEX_TPU_NO_COMPILE_CACHE", "0") in ("", "0"):
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("APEX_TPU_COMPILE_CACHE",
+                           "/tmp/apex_tpu_xla_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # older jax without the knobs
+
 # nominal bf16 dense peak TFLOP/s and HBM GB/s by device kind (public
 # cloud specs)
 _PEAKS = (
