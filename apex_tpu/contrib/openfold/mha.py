@@ -19,6 +19,7 @@ folds into the additive bias exactly as the reference does.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -68,6 +69,42 @@ def _drop5(x, what):
             )
         return x[0]
     return x
+
+
+_warned_fully_masked = False
+
+
+def _maybe_warn_fully_masked(key_mask):
+    """One-time heads-up for the kv_mask fast path's edge semantics.
+
+    The reference's ``(mask - 1) * inf`` bias makes a fully-masked row
+    softmax to a uniform average over values; the kernel's ``kv_mask``
+    input excludes masked keys exactly, so such a row yields zeros. Rows
+    with >=1 live key agree to kernel tolerance either way. A concrete
+    mask is checked cheaply so the common no-padded-row case stays
+    silent; under tracing the divergence is unknowable, so the warning
+    fires once unconditionally.
+    """
+    global _warned_fully_masked
+    if _warned_fully_masked:
+        return
+    if isinstance(key_mask, jax.core.Tracer):
+        fully_masked_possible = True
+    else:
+        fully_masked_possible = bool(
+            jnp.any(~jnp.any(key_mask != 0, axis=-1))
+        )
+    if fully_masked_possible:
+        _warned_fully_masked = True
+        warnings.warn(
+            "openfold attention_core: key-only masks use the flash "
+            "kernel's exact kv_mask path — a row whose keys are ALL "
+            "masked returns zeros, where the reference's (mask-1)*inf "
+            "bias returns a uniform average over values. If you rely on "
+            "the uniform-average behavior for fully-padded rows, fold "
+            "the mask into `bias` instead.",
+            stacklevel=4,
+        )
 
 
 def _to_bnsd(x):
@@ -125,6 +162,7 @@ def attention_core(
         # anything else becomes additive logits, as the reference does
         # with (mask - 1) * inf
         if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+            _maybe_warn_fully_masked(mask[:, 0, 0, :])
             kv_mask = jnp.broadcast_to(mask[:, 0, 0, :], (b, s_k))
         else:
             m = mask.astype(jnp.float32)

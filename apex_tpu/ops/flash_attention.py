@@ -224,7 +224,15 @@ def _scaled_q(q_ref, scale):
     """The softmax scale folded into the [bq, d] q block (16x cheaper than
     scaling the [bq, bk] score tile; fp32 mul before the cast keeps the
     rounding to one step). Shared by fwd/dq/dkv so the score computation
-    cannot desynchronise between kernels."""
+    cannot desynchronise between kernels.
+
+    Numerics: for bf16 inputs the scaled q rounds back to bf16 BEFORE the
+    MXU dot, a ~1-ulp-per-element divergence from designs that scale the
+    fp32 score tile (fp32 q is scaled in fp32, so is exact). It is
+    self-consistent across fwd/dq/dkv — lse/logits shift together — and
+    sits well inside the bf16 attention test tolerances; flagging it here
+    because it shifts lse by ~1e-3 vs a score-tile-scaled revision, which
+    matters only if a test ever pins lse against an external oracle."""
     return (q_ref[0, 0].astype(jnp.float32) * scale).astype(q_ref.dtype)
 
 

@@ -106,6 +106,16 @@ def pipeline_forward_backward_1f1b(
     param_ids = {id(p) for p in param_leaves}
     x0 = jnp.zeros_like(inputs[0])
     y0, flat0, treedef = stage_vjp_flat(x0)
+    # The fwd/bwd ring messages are sized off the stage INPUT; a stage
+    # whose output dtype/shape differs would be silently cast on every
+    # hop (shape errors are loud, dtype coercion is not) — refuse it.
+    if y0.shape != x0.shape or y0.dtype != x0.dtype:
+        raise TypeError(
+            "1F1B stage_fn must map activations to the same shape/dtype "
+            f"(stages are homogeneous across ranks): got {x0.dtype}"
+            f"{list(x0.shape)} -> {y0.dtype}{list(y0.shape)}. Cast inside "
+            "the stage so the pipeline messages carry one dtype."
+        )
     is_param = [id(r) in param_ids for r in flat0]
     buf_shapes = [
         (r.shape, r.dtype) for r, p in zip(flat0, is_param) if not p
