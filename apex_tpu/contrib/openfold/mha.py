@@ -80,10 +80,13 @@ def _maybe_warn_fully_masked(key_mask):
     The reference's ``(mask - 1) * inf`` bias makes a fully-masked row
     softmax to a uniform average over values; the kernel's ``kv_mask``
     input excludes masked keys exactly, so such a row yields zeros. Rows
-    with >=1 live key agree to kernel tolerance either way. A concrete
-    mask is checked cheaply so the common no-padded-row case stays
-    silent; under tracing the divergence is unknowable, so the warning
-    fires once unconditionally.
+    with >=1 live key agree to kernel tolerance either way. Traced masks
+    (the jit/perf path) warn once unconditionally — the divergence is
+    unknowable at trace time, and one warning per process is cheap.
+    Concrete masks are actually CHECKED, every call until one warns: the
+    check is a host sync, but an eager-mode caller is not on the perf
+    path, and a silent latch would miss the fully-padded batch the
+    warning exists for when it arrives after a clean first batch.
     """
     global _warned_fully_masked
     if _warned_fully_masked:

@@ -21,6 +21,8 @@ dtype, moot here).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -62,6 +64,7 @@ def lm_head_cross_entropy(
     labels: jax.Array,  # [N] int
     *,
     chunk_size: int = 2048,
+    save_logits_dtype=None,
 ) -> jax.Array:
     """Chunk-fused LM-head GEMM + cross entropy: per-row losses WITHOUT
     materialising the full ``[N, V]`` logits tensor.
@@ -78,10 +81,26 @@ def lm_head_cross_entropy(
     Gradients: d(hidden) per chunk and d(head_weight) summed across chunks
     by the scan transpose. ``N`` must be divisible by ``chunk_size`` (pick
     any divisor; it only changes peak memory).
+
+    ``save_logits_dtype`` (e.g. ``jnp.bfloat16``) switches backward from
+    rematerialise-the-chunk to save-the-logits — the loop-level analogue of
+    the reference kernel's save-the-half-precision-softmax mode
+    (``half_to_float=False``, ``xentropy_kernel.cu`` bprop reading the
+    saved fp16 softmax): forward keeps each chunk's logits in the given
+    compact dtype (``[N, V]`` total, half the fp32 footprint) and backward
+    skips the logits GEMM replay entirely. Costs O(N*V) saved memory for
+    one fewer GEMM pass + one fewer reduce pass per chunk; measured ~5
+    ms/step on the GPT-2 345M v5e bench. Logit precision: bf16 keeps
+    |logit| <= ~40 to ~0.3% relative, well inside half-softmax parity.
     """
     n, h = hidden.shape
     if n % chunk_size:
         raise ValueError(f"N ({n}) must be divisible by chunk_size ({chunk_size})")
+    if save_logits_dtype is not None:
+        return _lm_head_ce_saved(
+            hidden, head_weight, labels, chunk_size,
+            jnp.dtype(save_logits_dtype),
+        )
     hc = hidden.reshape(n // chunk_size, chunk_size, h)
     lc = labels.reshape(n // chunk_size, chunk_size)
 
@@ -105,3 +124,81 @@ def lm_head_cross_entropy(
     # scan's slice overhead. Keep the rolled scan.
     _, losses = jax.lax.scan(body, None, (hc, lc))
     return losses.reshape(n)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _lm_head_ce_saved(hidden, head_weight, labels, chunk_size, logits_dtype):
+    losses, _ = _lm_head_ce_saved_fwd(
+        hidden, head_weight, labels, chunk_size, logits_dtype
+    )
+    return losses
+
+
+def _lm_head_ce_saved_fwd(hidden, head_weight, labels, chunk_size,
+                          logits_dtype):
+    n, h = hidden.shape
+    nc = n // chunk_size
+    hc = hidden.reshape(nc, chunk_size, h)
+    lc = labels.reshape(nc, chunk_size)
+
+    def body(carry, xs):
+        hrow, lrow = xs
+        logits = jnp.einsum(
+            "ch,vh->cv", hrow, head_weight.astype(hrow.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(logits_dtype)
+        # the loss IS the CE of the quantized logits (the reference
+        # xentropy's fp16-logits convention): lse/gold derive from the
+        # SAVED values, so forward and backward see one tensor — and XLA
+        # writes the compact buffer straight out of the GEMM epilogue
+        # instead of materialising fp32 logits first (~4 ms/step on the
+        # 345M bench)
+        lf = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        gold = jnp.take_along_axis(lf, lrow[:, None], axis=-1)[:, 0]
+        return carry, (lse - gold, logits, lse)
+
+    _, (losses, saved_logits, lse) = jax.lax.scan(body, None, (hc, lc))
+    return losses.reshape(n), (hidden, head_weight, labels, saved_logits, lse)
+
+
+def _lm_head_ce_saved_bwd(chunk_size, logits_dtype, res, g):
+    hidden, head_weight, labels, saved_logits, lse = res
+    n, h = hidden.shape
+    nc = n // chunk_size
+    hc = hidden.reshape(nc, chunk_size, h)
+    lc = labels.reshape(nc, chunk_size)
+    gc = g.reshape(nc, chunk_size)
+    w_c = head_weight.astype(hidden.dtype)
+
+    def body(dw_acc, xs):
+        hrow, lrow, grow, lgt, ls = xs
+        # d(logits) = (softmax - onehot) * dloss, straight from the saved
+        # compact logits — no GEMM replay. Cast to the activation dtype
+        # before the two GEMMs so they run at MXU rate (bf16 gradient
+        # discipline, same as the dense layers').
+        p = jnp.exp(lgt.astype(jnp.float32) - ls[:, None])
+        # onehot as a broadcast iota-compare (fuses into the exp pass; a
+        # scatter here forces an extra full [chunk, V] memory pass)
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            == lrow[:, None]
+        )
+        dlogits = ((p - onehot) * grow[:, None]).astype(hidden.dtype)
+        dh = jnp.einsum("cv,vh->ch", dlogits, w_c,
+                        preferred_element_type=jnp.float32)
+        dw_acc = dw_acc + jnp.einsum(
+            "cv,ch->vh", dlogits, hrow, preferred_element_type=jnp.float32
+        )
+        return dw_acc, dh.astype(hidden.dtype)
+
+    dw0 = jnp.zeros(head_weight.shape, jnp.float32)
+    dw, dhc = jax.lax.scan(body, dw0, (hc, lc, gc, saved_logits, lse))
+    return (
+        dhc.reshape(n, h).astype(hidden.dtype),
+        dw.astype(head_weight.dtype),
+        None,
+    )
+
+
+_lm_head_ce_saved.defvjp(_lm_head_ce_saved_fwd, _lm_head_ce_saved_bwd)
