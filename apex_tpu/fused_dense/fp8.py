@@ -26,6 +26,7 @@ dependent — ``bench.py`` records the measured ratio.
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -33,6 +34,26 @@ import jax.numpy as jnp
 
 FP8_E4M3_MAX = 448.0
 FP8_E5M2_MAX = 57344.0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _pmax_nondiff(x, axes):
+    """`lax.pmax` as a non-differentiable statistic: amaxes describe the
+    data, not the graph, but `pmax` has no JVP rule, so a bare call
+    inside a differentiated loss fails at linearization even downstream
+    of `stop_gradient`. Forward = pmax; backward = zeros."""
+    return jax.lax.pmax(x, axes)
+
+
+def _pmax_nondiff_fwd(x, axes):
+    return jax.lax.pmax(x, axes), None
+
+
+def _pmax_nondiff_bwd(axes, _, g):
+    return (jnp.zeros_like(g),)
+
+
+_pmax_nondiff.defvjp(_pmax_nondiff_fwd, _pmax_nondiff_bwd)
 
 
 class Fp8TensorMeta(NamedTuple):
@@ -111,8 +132,10 @@ def _forward_metas(x, weight, state, margin, amax_reduction_axes):
     amax_x = jnp.max(jnp.abs(x)).astype(jnp.float32)
     amax_w = jnp.max(jnp.abs(weight)).astype(jnp.float32)
     if amax_reduction_axes is not None:
-        amax_x = jax.lax.pmax(amax_x, amax_reduction_axes)
-        amax_w = jax.lax.pmax(amax_w, amax_reduction_axes)
+        axes = tuple(amax_reduction_axes) if isinstance(
+            amax_reduction_axes, (tuple, list)) else amax_reduction_axes
+        amax_x = _pmax_nondiff(amax_x, axes)
+        amax_w = _pmax_nondiff(amax_w, axes)
     amax_x = jax.lax.stop_gradient(amax_x)
     amax_w = jax.lax.stop_gradient(amax_w)
     return (_updated_meta(state.x, amax_x, margin),

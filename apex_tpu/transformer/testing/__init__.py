@@ -17,7 +17,10 @@ from .standalone_transformer_lm import (  # noqa: F401
     gpt_forward,
     gpt_loss,
     gpt_partition_specs,
+    init_gpt_fp8_carriers,
+    init_gpt_fp8_states,
     init_gpt_params,
+    record_gpt_grad_amaxes,
     transformer_block,
 )
 from .standalone_gpt import gpt_model_provider  # noqa: F401

@@ -89,6 +89,22 @@ class GPTConfig:
     # zigzag_indices builds the permutation).
     context_parallel_axis: Optional[str] = None
     context_parallel_zigzag: bool = False
+    # Single-device chunked LM-head CE: save each chunk's logits in the
+    # compute dtype instead of rematerialising the chunk GEMM in backward
+    # (the reference xentropy kernel's save-the-half-softmax mode). Costs
+    # [b*s, vocab] saved memory in compute_dtype; saves one GEMM + one
+    # reduce pass per chunk (~5 ms/step on the 345M v5e bench).
+    ce_save_logits: bool = False
+    # fp8 (e4m3 fwd + e5m2 grads, TE-style delayed scaling) on the four
+    # projection GEMMs per layer (qkv / proj / fc1 / fc2). Thread
+    # ``init_gpt_fp8_states(cfg)`` through ``gpt_loss(...,
+    # fp8_states=..., fp8_carriers=...)``; amaxes are group-reduced over
+    # ``fp8_amax_reduction_axes`` (the reference amax-reduction group
+    # over (data, tensor), ``apex/transformer/parallel_state.py:280``).
+    fp8: bool = False
+    fp8_margin: float = 0.0
+    fp8_amax_history_len: int = 16
+    fp8_amax_reduction_axes: Optional[Tuple[str, ...]] = None
     # BERT extras
     add_binary_head: bool = False
 
@@ -191,6 +207,67 @@ def _dropout(x, rate, key, deterministic):
     return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
 
 
+FP8_GEMM_NAMES = ("qkv", "proj", "fc1", "fc2")
+
+
+def init_gpt_fp8_states(cfg: GPTConfig):
+    """Per-layer delayed-scaling state for the four projection GEMMs:
+    ``{name: Fp8DenseState with [L, ...] leaves}``. Thread through
+    ``gpt_loss(..., fp8_states=...)``; the returned states carry the
+    rolled x/w histories, and the gradient amaxes come back as the
+    ``fp8_carriers`` cotangent (fold with :func:`record_gpt_grad_amaxes`)."""
+    from apex_tpu.fused_dense import init_fp8_dense_state
+
+    one = init_fp8_dense_state(cfg.fp8_amax_history_len, with_grad_meta=True)
+    stack = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (cfg.num_layers,) + x.shape).copy(),
+        one,
+    )
+    return {name: stack for name in FP8_GEMM_NAMES}
+
+
+def init_gpt_fp8_carriers(cfg: GPTConfig):
+    """Zero per-layer gradient-amax carriers, ``{name: [L]}`` — pass as a
+    DIFFERENTIATED argument; its cotangent is the per-layer amax(dY)."""
+    return {
+        name: jnp.zeros((cfg.num_layers,), jnp.float32)
+        for name in FP8_GEMM_NAMES
+    }
+
+
+def record_gpt_grad_amaxes(cfg: GPTConfig, fp8_states, carrier_grads):
+    """Fold the backward-observed gradient amaxes (the carriers'
+    cotangent) into each layer's g meta, group-reduced over the amax
+    axes (call inside the same shard_map as the loss)."""
+    from apex_tpu.fused_dense import record_grad_amax
+
+    out = {}
+    for name in FP8_GEMM_NAMES:
+        amax = carrier_grads[name]
+        if cfg.fp8_amax_reduction_axes is not None:
+            amax = jax.lax.pmax(amax, cfg.fp8_amax_reduction_axes)
+        out[name] = jax.vmap(
+            lambda s, a: record_grad_amax(s, a, margin=cfg.fp8_margin)
+        )(fp8_states[name], amax)
+    return out
+
+
+def _fp8_dense(cfg, fp8, name, x, w, b):
+    """Single-device fp8 projection: e4m3 GEMM + bias; returns
+    ``(y, {name: new_state})``."""
+    from apex_tpu.fused_dense import fp8_fused_dense_qgrad
+
+    state, carrier = fp8[name]
+    y, new_state = fp8_fused_dense_qgrad(
+        x, w, None, state, carrier, margin=cfg.fp8_margin,
+        amax_reduction_axes=cfg.fp8_amax_reduction_axes,
+    )
+    y = y.astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y, new_state
+
+
 def parallel_attention(
     cfg: GPTConfig,
     lp: Dict[str, jax.Array],
@@ -200,7 +277,8 @@ def parallel_attention(
     dropout_key: Optional[jax.Array],
     deterministic: bool,
     layer_number: Optional[jax.Array] = None,
-) -> jax.Array:
+    fp8=None,  # {name: (Fp8DenseState, carrier)} for qkv/proj
+):
     """Self-attention (reference ``ParallelAttention``
     ``standalone_transformer_lm.py:210-400``): column-parallel fused QKV,
     head-parallel scaled-masked softmax, row-parallel output projection."""
@@ -209,7 +287,23 @@ def parallel_attention(
     np_local = cfg.num_attention_heads // tp
     hn = cfg.kv_channels
 
-    if axis_name is not None:
+    new_fp8 = {}
+    if fp8 is not None and axis_name is not None:
+        st, car = fp8["qkv"]
+        qkv, _, new_fp8["qkv"] = column_parallel_linear(
+            hidden, lp["qkv_w"].astype(hidden.dtype),
+            lp["qkv_b"].astype(hidden.dtype), axis_name=axis_name,
+            gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            fp8_state=st, fp8_grad_carrier=car,
+            fp8_amax_reduction_axes=cfg.fp8_amax_reduction_axes,
+            fp8_margin=cfg.fp8_margin,
+        )
+    elif fp8 is not None:
+        qkv, new_fp8["qkv"] = _fp8_dense(
+            cfg, fp8, "qkv", hidden, lp["qkv_w"].astype(hidden.dtype),
+            lp["qkv_b"])
+    elif axis_name is not None:
         qkv, _ = column_parallel_linear(
             hidden, lp["qkv_w"].astype(hidden.dtype),
             lp["qkv_b"].astype(hidden.dtype), axis_name=axis_name,
@@ -290,7 +384,7 @@ def parallel_attention(
             scale=1.0 / (hn ** 0.5),
         ).astype(hidden.dtype)
         ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, np_local * hn)
-        return _attn_out_proj(cfg, lp, ctx, axis_name)
+        return _attn_out_proj(cfg, lp, ctx, axis_name, fp8, new_fp8)
 
     # --- flash attention path (Pallas, O(s) memory) ---------------------
     # Replaces the materialised-[b,np,sq,sk] scores below when applicable:
@@ -400,12 +494,30 @@ def parallel_attention(
         ).astype(hidden.dtype)
         ctx = ctx.reshape(s, b, np_local * hn)
 
-    return _attn_out_proj(cfg, lp, ctx, axis_name)
+    return _attn_out_proj(cfg, lp, ctx, axis_name, fp8, new_fp8)
 
 
-def _attn_out_proj(cfg, lp, ctx, axis_name):
+def _attn_out_proj(cfg, lp, ctx, axis_name, fp8=None, new_fp8=None):
     """Row-parallel (or dense) attention output projection, shared by the
-    flash/XLA and ring-attention context-parallel paths."""
+    flash/XLA and ring-attention context-parallel paths. With fp8 active,
+    returns ``(out, new_fp8)`` carrying the rolled qkv/proj states."""
+    if fp8 is not None and axis_name is not None:
+        st, car = fp8["proj"]
+        out, _, new_fp8["proj"] = row_parallel_linear(
+            ctx, lp["proj_w"].astype(ctx.dtype),
+            lp["proj_b"].astype(ctx.dtype), axis_name=axis_name,
+            input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            fp8_state=st, fp8_grad_carrier=car,
+            fp8_amax_reduction_axes=cfg.fp8_amax_reduction_axes,
+            fp8_margin=cfg.fp8_margin,
+        )
+        return out, new_fp8
+    if fp8 is not None:
+        out, new_fp8["proj"] = _fp8_dense(
+            cfg, fp8, "proj", ctx, lp["proj_w"].astype(ctx.dtype),
+            lp["proj_b"])
+        return out, new_fp8
     if axis_name is not None:
         out, _ = row_parallel_linear(
             ctx, lp["proj_w"].astype(ctx.dtype),
@@ -424,9 +536,44 @@ def parallel_mlp(
     lp: Dict[str, jax.Array],
     hidden: jax.Array,
     axis_name: Optional[str],
-) -> jax.Array:
+    fp8=None,  # {name: (Fp8DenseState, carrier)} for fc1/fc2
+):
     """Reference ``ParallelMLP`` (``standalone_transformer_lm.py:89-130``):
-    column-parallel h→4h, fused bias-GeLU, row-parallel 4h→h."""
+    column-parallel h→4h, fused bias-GeLU, row-parallel 4h→h. With fp8
+    active, returns ``(out, new_fp8)``."""
+    new_fp8 = {}
+    if fp8 is not None and axis_name is not None:
+        st1, car1 = fp8["fc1"]
+        inter, _, new_fp8["fc1"] = column_parallel_linear(
+            hidden, lp["fc1_w"].astype(hidden.dtype),
+            lp["fc1_b"].astype(hidden.dtype), axis_name=axis_name,
+            gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            fp8_state=st1, fp8_grad_carrier=car1,
+            fp8_amax_reduction_axes=cfg.fp8_amax_reduction_axes,
+            fp8_margin=cfg.fp8_margin,
+        )
+        inter = jax.nn.gelu(inter, approximate=True)
+        st2, car2 = fp8["fc2"]
+        out, _, new_fp8["fc2"] = row_parallel_linear(
+            inter, lp["fc2_w"].astype(inter.dtype),
+            lp["fc2_b"].astype(inter.dtype), axis_name=axis_name,
+            input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            fp8_state=st2, fp8_grad_carrier=car2,
+            fp8_amax_reduction_axes=cfg.fp8_amax_reduction_axes,
+            fp8_margin=cfg.fp8_margin,
+        )
+        return out, new_fp8
+    if fp8 is not None:
+        inter, new_fp8["fc1"] = _fp8_dense(
+            cfg, fp8, "fc1", hidden, lp["fc1_w"].astype(hidden.dtype),
+            lp["fc1_b"])
+        inter = jax.nn.gelu(inter, approximate=True)
+        out, new_fp8["fc2"] = _fp8_dense(
+            cfg, fp8, "fc2", inter, lp["fc2_w"].astype(inter.dtype),
+            lp["fc2_b"])
+        return out, new_fp8
     if axis_name is not None:
         inter, _ = column_parallel_linear(
             hidden, lp["fc1_w"].astype(hidden.dtype),
@@ -458,8 +605,10 @@ def transformer_layer(
     dropout_key: Optional[jax.Array],
     deterministic: bool,
     layer_number: Optional[jax.Array] = None,
-) -> jax.Array:
-    """Pre-LN transformer layer (reference ``ParallelTransformerLayer``)."""
+    fp8_l=None,  # {name: (Fp8DenseState, carrier)}, this layer's slice
+):
+    """Pre-LN transformer layer (reference ``ParallelTransformerLayer``).
+    With ``fp8_l`` set, returns ``(hidden, new_fp8_l)``."""
     dt = hidden.dtype
     k1 = k2 = k3 = None
     if dropout_key is not None:
@@ -471,8 +620,12 @@ def transformer_layer(
     ).astype(dt)
     attn = parallel_attention(
         cfg, lp, ln1, attention_mask, axis_name, k1, deterministic,
-        layer_number,
+        layer_number, fp8=fp8_l,
     )
+    new_fp8 = {}
+    if fp8_l is not None:
+        attn, attn_fp8 = attn
+        new_fp8.update(attn_fp8)
     hidden = (hidden + _dropout(attn, cfg.hidden_dropout, k3,
                                deterministic)).astype(dt)
 
@@ -480,9 +633,15 @@ def transformer_layer(
         hidden.astype(jnp.float32), lp["post_ln_w"].astype(jnp.float32),
         lp["post_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
     ).astype(dt)
-    mlp_out = parallel_mlp(cfg, lp, ln2, axis_name)
-    return (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
-                              deterministic)).astype(dt)
+    mlp_out = parallel_mlp(cfg, lp, ln2, axis_name, fp8=fp8_l)
+    if fp8_l is not None:
+        mlp_out, mlp_fp8 = mlp_out
+        new_fp8.update(mlp_fp8)
+    out = (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
+                             deterministic)).astype(dt)
+    if fp8_l is not None:
+        return out, new_fp8
+    return out
 
 
 # pallas kernels whose forward outputs 'selective' recompute stores: the
@@ -516,7 +675,9 @@ def transformer_block(
     axis_name: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
-) -> jax.Array:
+    fp8_states=None,  # {name: Fp8DenseState [L, ...]}
+    fp8_carriers=None,  # {name: [L]}
+):
     """Scan the stacked layers (reference ``ParallelTransformer`` loop).
 
     ``recompute_granularity="full"`` rematerialises each layer in backward —
@@ -524,19 +685,35 @@ def transformer_block(
     checkpointing (``tensor_parallel/random.py:237``); ``"selective"``
     keeps matmul outputs and replays only the cheap elementwise/softmax work
     (the reference's ``--recompute-granularity selective``).
+
+    With ``fp8_states``/``fp8_carriers`` the per-layer state slices ride
+    the scan's xs and the rolled states come back as ys: returns
+    ``(hidden, new_fp8_states)``.
     """
     L = layer_params["qkv_w"].shape[0]
+    with_fp8 = fp8_states is not None
 
     def body(carry, xs):
         h, key = carry
-        lp, layer_number = xs
+        if with_fp8:
+            lp, layer_number, fp8_sl, fp8_cl = xs
+            fp8_l = {
+                name: (fp8_sl[name], fp8_cl[name])
+                for name in FP8_GEMM_NAMES
+            }
+        else:
+            lp, layer_number = xs
+            fp8_l = None
         sub = None
         if key is not None:
             key, sub = jax.random.split(key)
         h = transformer_layer(
             cfg, lp, h, attention_mask, axis_name, sub, deterministic,
-            layer_number,
+            layer_number, fp8_l=fp8_l,
         )
+        if with_fp8:
+            h, new_fp8_l = h
+            return (h, key), new_fp8_l
         return (h, key), None
 
     if cfg.recompute_granularity == "full":
@@ -557,11 +734,15 @@ def transformer_block(
             f"layer_unroll must be >= 1 or the sentinel -1 (full), got "
             f"{cfg.layer_unroll}"
         )
-    (hidden, _), _ = jax.lax.scan(
-        body, (hidden, dropout_key),
-        (layer_params, jnp.arange(1, L + 1)), length=L,
+    xs = (layer_params, jnp.arange(1, L + 1))
+    if with_fp8:
+        xs = xs + (fp8_states, fp8_carriers)
+    (hidden, _), ys = jax.lax.scan(
+        body, (hidden, dropout_key), xs, length=L,
         unroll=max(1, min(unroll, L)),
     )
+    if with_fp8:
+        return hidden, ys
     return hidden
 
 
@@ -639,10 +820,21 @@ def gpt_hidden(
     axis_name: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
-) -> jax.Array:
+    fp8_states=None,
+    fp8_carriers=None,
+):
     """GPT trunk → pre-head hidden states [s, b, h] (embeddings, layer
     stack, final LN, SP gather) — everything of ``gpt_forward`` except the
-    LM-head projection."""
+    LM-head projection. With ``fp8_states`` the projection GEMMs run the
+    e4m3/e5m2 recipe and ``(hidden, new_fp8_states)`` is returned."""
+    if bool(cfg.fp8) != (fp8_states is not None):
+        raise ValueError(
+            "GPTConfig.fp8 and the fp8_states argument must agree: the "
+            "flag declares the recipe, the state carries it — pass "
+            "init_gpt_fp8_states(cfg) (+ carriers) when cfg.fp8, and "
+            "don't pass states to a non-fp8 config. (The flag alone "
+            "cannot run fp8: delayed scaling is stateful.)"
+        )
     k_embed = k_block = None
     if dropout_key is not None:
         if axis_name is not None and cfg.sequence_parallel:
@@ -661,10 +853,13 @@ def gpt_hidden(
     hidden = gpt_embed(
         cfg, params, tokens, None, axis_name, k_embed, deterministic
     )
+    new_fp8 = None
     hidden = transformer_block(
         cfg, params["layers"], hidden, None, axis_name, k_block,
-        deterministic,
+        deterministic, fp8_states=fp8_states, fp8_carriers=fp8_carriers,
     )
+    if fp8_states is not None:
+        hidden, new_fp8 = hidden
     hidden = fused_layer_norm(
         hidden.astype(jnp.float32),
         params["final_ln_w"].astype(jnp.float32),
@@ -678,6 +873,8 @@ def gpt_hidden(
         hidden = mappings.gather_from_sequence_parallel_region(
             hidden, axis_name
         )
+    if fp8_states is not None:
+        return hidden, new_fp8
     return hidden
 
 
@@ -688,14 +885,24 @@ def gpt_forward(
     axis_name: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
-) -> jax.Array:
+    fp8_states=None,
+    fp8_carriers=None,
+):
     """Full GPT forward → vocab(-parallel) logits [b, s, v(/tp)]
-    (reference ``GPTModel.forward`` + ``post_language_model_processing``)."""
+    (reference ``GPTModel.forward`` + ``post_language_model_processing``).
+    With ``fp8_states``: returns ``(logits, new_fp8_states)``."""
     hidden = gpt_hidden(
-        cfg, params, tokens, axis_name, dropout_key, deterministic
+        cfg, params, tokens, axis_name, dropout_key, deterministic,
+        fp8_states=fp8_states, fp8_carriers=fp8_carriers,
     )
+    new_fp8 = None
+    if fp8_states is not None:
+        hidden, new_fp8 = hidden
     logits = _lm_head(cfg, params, hidden, axis_name)
-    return jnp.transpose(logits, (1, 0, 2))  # [b, s, v(/tp)]
+    logits = jnp.transpose(logits, (1, 0, 2))  # [b, s, v(/tp)]
+    if fp8_states is not None:
+        return logits, new_fp8
+    return logits
 
 
 def _lm_head(cfg, params, hidden, axis_name):
@@ -722,25 +929,39 @@ def gpt_loss(
     axis_name: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
-) -> jax.Array:
+    fp8_states=None,
+    fp8_carriers=None,
+):
     """Masked mean LM loss (reference GPT ``loss_func``).
 
     Single-device path: the head GEMM and the CE are chunk-fused
     (``contrib.xentropy.lm_head_cross_entropy``) so the ``[b*s, vocab]``
     fp32 logits tensor is never fully materialised; TP path: vocab-parallel
     CE over the sharded logits.
+
+    With ``fp8_states``/``fp8_carriers`` (see :func:`init_gpt_fp8_states`)
+    the layer projections run the fp8 recipe and ``(loss,
+    new_fp8_states)`` is returned — differentiate w.r.t. the carriers and
+    fold their cotangent with :func:`record_gpt_grad_amaxes`.
     """
+    new_fp8 = None
     if axis_name is not None:
         logits = gpt_forward(
-            cfg, params, tokens, axis_name, dropout_key, deterministic
+            cfg, params, tokens, axis_name, dropout_key, deterministic,
+            fp8_states=fp8_states, fp8_carriers=fp8_carriers,
         )
+        if fp8_states is not None:
+            logits, new_fp8 = logits
         losses = vocab_parallel_cross_entropy(logits, labels, 0.0, axis_name)
     else:
         from apex_tpu.contrib.xentropy import lm_head_cross_entropy
 
         hidden = gpt_hidden(
-            cfg, params, tokens, axis_name, dropout_key, deterministic
+            cfg, params, tokens, axis_name, dropout_key, deterministic,
+            fp8_states=fp8_states, fp8_carriers=fp8_carriers,
         )
+        if fp8_states is not None:
+            hidden, new_fp8 = hidden
         s, b, h = hidden.shape
         n = s * b
         # largest divisor of n that is <= 2048: keeps the chunked-CE memory
@@ -756,6 +977,9 @@ def gpt_loss(
             params["embedding"]["word"],
             jnp.transpose(labels, (1, 0)).reshape(n),  # [s, b] row order
             chunk_size=chunk,
+            save_logits_dtype=(
+                cfg.compute_dtype if cfg.ce_save_logits else None
+            ),
         ).reshape(s, b)
         losses = jnp.transpose(losses, (1, 0))  # [b, s]
     if cfg.context_parallel_axis is not None:
@@ -766,11 +990,15 @@ def gpt_loss(
              else loss_mask.astype(jnp.float32))
         num = jax.lax.psum(jnp.sum(losses * m), a)
         den = jax.lax.psum(jnp.sum(m), a)
-        return num / jnp.maximum(den, 1.0)
-    if loss_mask is None:
-        return jnp.mean(losses)
-    m = loss_mask.astype(jnp.float32)
-    return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        loss = num / jnp.maximum(den, 1.0)
+    elif loss_mask is None:
+        loss = jnp.mean(losses)
+    else:
+        m = loss_mask.astype(jnp.float32)
+        loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+    if fp8_states is not None:
+        return loss, new_fp8
+    return loss
 
 
 # --------------------------------------------------------------------------

@@ -216,3 +216,114 @@ def test_fp8_qgrad_requires_grad_meta_and_e5m2_saturates():
     assert q.dtype == jnp.float8_e5m2
     np.testing.assert_allclose(
         q.astype(jnp.float32)[:2], [FP8_E5M2_MAX, -FP8_E5M2_MAX])
+
+
+def test_fp8_gpt_end_to_end_single_device():
+    """The round-5 wiring (VERDICT r4 #3): every projection GEMM of the
+    standalone GPT on the e4m3/e5m2 path, state threaded through the
+    layer scan, grad amaxes recorded via the carriers. Loss must track
+    the exact path to e4m3 noise and the delayed-scaling state must
+    calibrate."""
+    from apex_tpu.transformer.testing import (
+        GPTConfig, gpt_loss, init_gpt_fp8_carriers, init_gpt_fp8_states,
+        init_gpt_params, record_gpt_grad_amaxes,
+    )
+
+    import dataclasses
+
+    cfg = GPTConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=32, hidden_dropout=0.0,
+        attention_dropout=0.0, fp8=True,
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    states = init_gpt_fp8_states(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 128)
+    # exact reference: same model, fp8 OFF (the flag and the states must
+    # agree — gpt_hidden validates the pairing)
+    ref = float(gpt_loss(
+        dataclasses.replace(cfg, fp8=False), params, tokens, labels))
+
+    with pytest.raises(ValueError, match="must agree"):
+        gpt_loss(cfg, params, tokens, labels)  # flag without states
+
+    def loss_fn(p, c, states):
+        return gpt_loss(cfg, p, tokens, labels, fp8_states=states,
+                        fp8_carriers=c)
+
+    for _ in range(2):
+        carriers = init_gpt_fp8_carriers(cfg)
+        (loss, new_states), (grads, amaxes) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(
+                params, carriers, states)
+        states = record_gpt_grad_amaxes(cfg, new_states, amaxes)
+    assert abs(float(loss) - ref) / ref < 0.1, (float(loss), ref)
+    # histories populated for all four GEMMs; g scales derived
+    for name in ("qkv", "proj", "fc1", "fc2"):
+        assert float(states[name].x.amax_history[0, 0]) > 0, name
+        assert float(states[name].w.amax_history[0, 0]) > 0, name
+        assert float(states[name].g.amax_history[0, 0]) > 0, name
+        assert float(states[name].g.scale[0]) != 1.0, name
+    # gradients flow to the params through the quantized GEMMs
+    gnorm = jnp.linalg.norm(grads["layers"]["qkv_w"].reshape(-1))
+    assert float(gnorm) > 0
+
+
+def test_fp8_gpt_tensor_parallel_amax_synced():
+    """TP=8 fp8 GPT step: the column/row projections run fp8 per-shard
+    with amax group-reduced over (data, tensor) — every rank derives the
+    same scale (the reference amax group's purpose,
+    ``parallel_state.py:280-292``)."""
+    from apex_tpu.transformer.testing import (
+        GPTConfig, gpt_loss, gpt_partition_specs, init_gpt_fp8_carriers,
+        init_gpt_fp8_states, init_gpt_params, record_gpt_grad_amaxes,
+    )
+
+    parallel_state.initialize_model_parallel(8, 1, use_fp8_=True)
+    try:
+        mesh = parallel_state.get_mesh()
+        ta = parallel_state.TENSOR_AXIS
+        da = parallel_state.DATA_AXIS
+        cfg = GPTConfig(
+            num_layers=2, hidden_size=32, num_attention_heads=8,
+            vocab_size=128, max_position_embeddings=32,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            tensor_model_parallel_size=8, fp8=True,
+            fp8_amax_reduction_axes=(da, ta),
+        )
+        params = init_gpt_params(cfg, jax.random.PRNGKey(3))
+        states = init_gpt_fp8_states(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 128)
+        labels = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, 128)
+        specs = gpt_partition_specs(cfg)
+
+        def local(params, states, tokens, labels):
+            carriers = init_gpt_fp8_carriers(cfg)
+
+            def loss_fn(p, c):
+                return gpt_loss(cfg, p, tokens, labels, axis_name=ta,
+                                fp8_states=states, fp8_carriers=c)
+
+            (loss, new_states), (_, amaxes) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, carriers)
+            new_states = record_gpt_grad_amaxes(cfg, new_states, amaxes)
+            probe = jnp.stack([
+                new_states["qkv"].x.scale[0],
+                new_states["fc2"].g.amax_history[0, 0],
+            ])
+            return loss, jax.lax.all_gather(probe, (da, ta)).reshape(-1, 2)
+
+        st_specs = jax.tree_util.tree_map(lambda _: P(), states)
+        loss, probes = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(specs, st_specs, P(), P()),
+            out_specs=(P(), P()), check_vma=False,
+        ))(params, states, tokens, labels)
+        assert np.isfinite(float(loss))
+        probes = np.asarray(probes)
+        assert np.all(probes == probes[0:1]), probes
+        assert probes[0, 0] != 1.0  # scale actually derived
+        assert probes[0, 1] > 0  # grad amax recorded
+    finally:
+        parallel_state.destroy_model_parallel()

@@ -114,6 +114,31 @@ def test_gpt_recompute_matches_plain():
     )
 
 
+def test_gpt_ce_save_logits_matches_remat():
+    """`ce_save_logits=True` (save-the-compact-logits CE backward, the
+    round-5 bench configuration) must match the default remat-chunk CE
+    in both loss and gradients (fp32: the saved dtype = compute dtype,
+    so the comparison is exact up to reduction order)."""
+    cfg = _small_cfg()
+    cfg_s = _small_cfg(ce_save_logits=True)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(5))
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, 128)
+    l1 = gpt_loss(cfg, params, tokens, labels)
+    l2 = gpt_loss(cfg_s, params, tokens, labels)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    g1 = jax.grad(lambda p: gpt_loss(cfg, p, tokens, labels))(params)
+    g2 = jax.grad(lambda p: gpt_loss(cfg_s, p, tokens, labels))(params)
+    np.testing.assert_allclose(
+        np.asarray(g1["embedding"]["word"]),
+        np.asarray(g2["embedding"]["word"]), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(g1["layers"]["qkv_w"]), np.asarray(g2["layers"]["qkv_w"]),
+        atol=1e-5,
+    )
+
+
 def test_gpt_cpu_offload_matches():
     cfg = _small_cfg()
     params, fwd, loss = gpt_model_provider(
