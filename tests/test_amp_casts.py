@@ -1,0 +1,315 @@
+"""O1 autocast behavioral tests — the reference L0/run_amp port.
+
+Mirrors ``tests/L0/run_amp/test_basic_casts.py`` (whitelist ops produce
+half, blacklist ops produce float, unlisted ops match input),
+``test_promotion.py`` (mixed-dtype n-ary ops produce the widest type),
+``test_cache.py`` (the cast cache does not change gradients), and
+``test_rnn.py`` (RNN cells are covered by the policy) — on the JAX O1
+surface (``apex_tpu/amp/lists/jax_overrides.py``), with bf16 playing
+fp16's role.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.lists import jax_overrides as jo
+from apex_tpu.RNN.cells import (
+    GRUCell,
+    LSTMCell,
+    RNNReLUCell,
+    RNNTanhCell,
+)
+
+B, H = 4, 16
+
+
+def _x(dtype, key=0, shape=(B, H)):
+    return jax.random.normal(jax.random.PRNGKey(key), shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# basic casts (reference test_basic_casts.py)
+# ---------------------------------------------------------------------------
+
+LOW_PRECISION_CALLS = [
+    ("matmul", lambda x: jnp.matmul(x, x.T)),
+    ("dot", lambda x: jnp.dot(x, x.T)),
+    ("einsum", lambda x: jnp.einsum("bh,oh->bo", x, x)),
+    ("tensordot", lambda x: jnp.tensordot(x, x, axes=((1,), (1,)))),
+    ("inner", lambda x: jnp.inner(x, x)),
+    ("vdot", lambda x: jnp.vdot(x, x)),
+    ("outer", lambda x: jnp.outer(x[0], x[0])),
+    ("kron", lambda x: jnp.kron(x[:2, :2], x[:2, :2])),
+    ("lax.dot", lambda x: jax.lax.dot(x, x.T)),
+]
+
+
+@pytest.mark.parametrize("name,fn", LOW_PRECISION_CALLS,
+                         ids=[n for n, _ in LOW_PRECISION_CALLS])
+@pytest.mark.parametrize("in_dtype", [jnp.float32, jnp.bfloat16])
+def test_whitelist_is_low_precision(name, fn, in_dtype):
+    """ALWAYS_HALF: whitelist ops produce bf16 from either input dtype."""
+    with amp.autocast(dtype=jnp.bfloat16):
+        y = fn(_x(in_dtype))
+    assert y.dtype == jnp.bfloat16, (name, in_dtype, y.dtype)
+
+
+FP32_CALLS = [
+    ("exp", lambda x: jnp.exp(x)),
+    ("log", lambda x: jnp.log(jnp.abs(x) + 1.0)),
+    ("power", lambda x: jnp.power(jnp.abs(x) + 0.5, 2.5)),
+    ("sum", lambda x: jnp.sum(x)),
+    ("mean", lambda x: jnp.mean(x)),
+    ("std", lambda x: jnp.std(x)),
+    ("var", lambda x: jnp.var(x)),
+    ("nanmean", lambda x: jnp.nanmean(x)),
+    ("cumsum", lambda x: jnp.cumsum(x, axis=-1)),
+    ("softmax", lambda x: jax.nn.softmax(x, axis=-1)),
+    ("log_softmax", lambda x: jax.nn.log_softmax(x, axis=-1)),
+    ("logsumexp", lambda x: jax.nn.logsumexp(x, axis=-1)),
+    ("gelu", lambda x: jax.nn.gelu(x)),
+    ("norm", lambda x: jnp.linalg.norm(x)),
+    ("erf", lambda x: jax.scipy.special.erf(x)),
+    ("xlogy", lambda x: jax.scipy.special.xlogy(
+        jnp.abs(x), jnp.abs(x) + 1.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn", FP32_CALLS,
+                         ids=[n for n, _ in FP32_CALLS])
+@pytest.mark.parametrize("in_dtype", [jnp.float32, jnp.bfloat16])
+def test_blacklist_is_float(name, fn, in_dtype):
+    """ALWAYS_FLOAT: blacklist ops produce fp32 from either input dtype."""
+    with amp.autocast(dtype=jnp.bfloat16):
+        y = fn(_x(in_dtype))
+    assert y.dtype == jnp.float32, (name, in_dtype, y.dtype)
+
+
+def test_loss_helpers_are_float():
+    """The functional_overrides losses (mse/cross-entropy class) — optax
+    is this stack's home for them."""
+    import optax
+
+    with amp.autocast(dtype=jnp.bfloat16):
+        l2 = optax.l2_loss(_x(jnp.bfloat16), _x(jnp.bfloat16, 1))
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            _x(jnp.bfloat16), jnp.zeros((B,), jnp.int32))
+    assert l2.dtype == jnp.float32
+    assert ce.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("in_dtype", [jnp.float32, jnp.bfloat16])
+def test_unlisted_matches_input(in_dtype):
+    """MATCH_INPUT: ops on neither list keep their input dtype."""
+    with amp.autocast(dtype=jnp.bfloat16):
+        y = jax.nn.relu(_x(in_dtype))
+        z = jnp.tanh(_x(in_dtype))
+    assert y.dtype == in_dtype
+    assert z.dtype == in_dtype
+
+
+def test_backward_matches_input_dtype():
+    """Reference run_layer_test(test_backward=True): the grad w.r.t. an
+    input has the INPUT's dtype regardless of the op's cast."""
+    for in_dtype in (jnp.float32, jnp.bfloat16):
+        x = _x(in_dtype)
+
+        def loss(x):
+            with amp.autocast(dtype=jnp.bfloat16):
+                return jnp.sum(jnp.matmul(x, x.T).astype(jnp.float32))
+
+        g = jax.grad(loss)(x)
+        assert g.dtype == in_dtype
+
+
+def test_every_registered_entry_is_patchable():
+    """Every (module, name) on both lists must exist, wrap on entry, and
+    restore on exit — the per-op structural guarantee behind the
+    behavioral samples above."""
+    originals = {}
+    for module, name in jo.LOW_PRECISION_FUNCS + jo.FP32_FUNCS:
+        originals[(id(module), name)] = getattr(module, name)
+    with amp.autocast(dtype=jnp.bfloat16):
+        for module, name in jo.LOW_PRECISION_FUNCS + jo.FP32_FUNCS:
+            assert hasattr(getattr(module, name), "__apex_tpu_wrapped__"), (
+                module, name)
+    for module, name in jo.LOW_PRECISION_FUNCS + jo.FP32_FUNCS:
+        assert getattr(module, name) is originals[(id(module), name)], (
+            module, name)
+
+
+def test_list_sizes_cover_reference_surface():
+    """The reference ships ~230 entries over three lists; the JAX surface
+    is denser (one op covers several torch spellings) but must stay wide:
+    >= 120 entries with the promote list, >= 100 patched."""
+    patched = len(jo.LOW_PRECISION_FUNCS) + len(jo.FP32_FUNCS)
+    assert patched >= 100, patched
+    assert patched + len(jo.PROMOTE_FUNCS) >= 120
+
+
+# ---------------------------------------------------------------------------
+# promotion (reference test_promotion.py)
+# ---------------------------------------------------------------------------
+
+PROMOTE_BINARY_CALLS = [
+    ("add", jnp.add),
+    ("multiply", jnp.multiply),
+    ("subtract", jnp.subtract),
+    ("maximum", jnp.maximum),
+    ("fmod", jnp.fmod),
+    ("copysign", jnp.copysign),
+]
+
+
+@pytest.mark.parametrize("name,fn", PROMOTE_BINARY_CALLS,
+                         ids=[n for n, _ in PROMOTE_BINARY_CALLS])
+def test_binary_promotes_to_widest(name, fn):
+    """Out-of-place binary ops match the widest input type (the behavior
+    the reference's promote wrapper creates; JAX provides it natively —
+    these tests pin that the native behavior keeps matching)."""
+    hi = _x(jnp.float32)
+    lo = _x(jnp.bfloat16, 1)
+    with amp.autocast(dtype=jnp.bfloat16):
+        assert fn(hi, lo).dtype == jnp.float32, name
+        assert fn(lo, hi).dtype == jnp.float32, name
+        assert fn(lo, lo).dtype == jnp.bfloat16, name
+
+
+def test_cat_matches_widest():
+    ys = [_x(jnp.bfloat16, k) for k in range(5)]
+    with amp.autocast(dtype=jnp.bfloat16):
+        out = jnp.concatenate(ys + [_x(jnp.float32, 9)])
+        assert out.dtype == jnp.float32
+        out = jnp.concatenate(ys + [_x(jnp.bfloat16, 9)])
+        assert out.dtype == jnp.bfloat16
+
+
+def test_where_promotes_to_widest():
+    with amp.autocast(dtype=jnp.bfloat16):
+        out = jnp.where(_x(jnp.float32) > 0, _x(jnp.bfloat16, 1),
+                        _x(jnp.float32, 2))
+    assert out.dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# cast cache (reference test_cache.py)
+# ---------------------------------------------------------------------------
+
+def test_cache_does_not_change_gradients():
+    """Reference test_cache's property: training with the cast cache on
+    gives the same gradients as with it off, to bf16 tolerance (the
+    cache must be a pure memoization of casts, never a stale value).
+    The residual difference is the reuse itself: a shared cast node sums
+    its two cotangents in bf16 where separate casts sum in fp32 — the
+    same accumulate-at-the-cast behavior the reference's cached half
+    weights have — so both are compared against the fp32 gradient."""
+    w1 = _x(jnp.float32, 1, (H, H))
+    w2 = _x(jnp.float32, 2, (H, H))
+    x = _x(jnp.float32, 3)
+
+    def loss(w1, w2, cache):
+        with amp.autocast(dtype=jnp.bfloat16, cache_casts=cache):
+            # w1 used twice: the second use must hit the cache (when on)
+            h = jnp.matmul(jnp.matmul(x, w1), w2)
+            h = jnp.matmul(h, w1)
+            return jnp.sum(h.astype(jnp.float32))
+
+    def loss_fp32(w1, w2):
+        h = jnp.matmul(jnp.matmul(x, w1), w2)
+        return jnp.sum(jnp.matmul(h, w1))
+
+    g_on = jax.grad(loss, argnums=(0, 1))(w1, w2, True)
+    g_off = jax.grad(loss, argnums=(0, 1))(w1, w2, False)
+    g_ref = jax.grad(loss_fp32, argnums=(0, 1))(w1, w2)
+    for a, b, r in zip(g_on, g_off, g_ref):
+        scale = float(jnp.abs(r).max())
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.02 * scale)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), atol=0.05 * scale)
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(r), atol=0.05 * scale)
+
+
+# ---------------------------------------------------------------------------
+# RNN cells under the policy (reference test_rnn.py + rnn_compat)
+# ---------------------------------------------------------------------------
+
+def _cell_params(gates, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 2)
+    return {
+        "w_ih": jax.random.normal(ks[0], (gates * H, H)) * 0.1,
+        "w_hh": jax.random.normal(ks[1], (gates * H, H)) * 0.1,
+    }
+
+
+CELLS = [
+    ("rnn_relu", RNNReLUCell, 1, False),
+    ("rnn_tanh", RNNTanhCell, 1, False),
+    ("lstm", LSTMCell, 4, True),
+    ("gru", GRUCell, 3, False),
+]
+
+
+@pytest.mark.parametrize("name,cell,gates,tuple_state", CELLS,
+                         ids=[c[0] for c in CELLS])
+def test_rnn_cell_is_low_precision(name, cell, gates, tuple_state):
+    """The scan cells' gate GEMMs ride the patched ``jnp.einsum``, so an
+    fp32 cell under autocast computes (and returns) bf16 — the reference
+    rnn_compat behavior without a special case. Gradients stay finite
+    and input-dtyped."""
+    params = _cell_params(gates)
+    x = _x(jnp.float32, 7)
+    h0 = jnp.zeros((B, H), jnp.float32)
+    state = (h0, h0) if tuple_state else h0
+
+    with amp.autocast(dtype=jnp.bfloat16):
+        # two steps: step 1's fp32 zero state promotes the gated update
+        # (f*c + i*g) back to fp32 for LSTM/GRU; in steady state the
+        # carry is the previous bf16 output and the cell runs bf16
+        # end-to-end — assert THAT, the dtype a scan actually carries
+        out = cell(params, x, state)
+        out = cell(params, x,
+                   jax.tree_util.tree_map(
+                       lambda t: t.astype(jnp.bfloat16), out))
+    y = out[0] if tuple_state else out
+    assert y.dtype == jnp.bfloat16, (name, y.dtype)
+
+    def loss(params, x):
+        with amp.autocast(dtype=jnp.bfloat16):
+            o = cell(params, x, state)
+        o = o[0] if tuple_state else o
+        return jnp.sum(o.astype(jnp.float32))
+
+    gp, gx = jax.grad(loss, argnums=(0, 1))(params, x)
+    assert gx.dtype == x.dtype
+    assert all(bool(jnp.isfinite(g).all())
+               for g in jax.tree_util.tree_leaves(gp))
+
+
+def test_rnn_scan_traces_under_policy():
+    """A full lax.scan over an LSTM cell inside autocast: the policy must
+    survive tracing (patched fns are looked up at trace time)."""
+    params = _cell_params(4, key=1)
+    xs = jax.random.normal(jax.random.PRNGKey(8), (6, B, H))
+    h0 = jnp.zeros((B, H), jnp.float32)
+
+    @jax.jit
+    def run(params, xs):
+        with amp.autocast(dtype=jnp.bfloat16):
+            # the carry must be dtype-stable across scan ticks: start it
+            # in the compute dtype the cell emits under the policy
+            c0 = (h0.astype(jnp.bfloat16), h0.astype(jnp.bfloat16))
+
+            def step(carry, x):
+                h, c = LSTMCell(params, x, carry)
+                return (h, c), h
+
+            _, ys = jax.lax.scan(step, c0, xs)
+            return ys
+
+    ys = run(params, xs)
+    assert ys.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(ys.astype(jnp.float32)).all())
