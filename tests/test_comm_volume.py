@@ -1,0 +1,278 @@
+"""Communication-VOLUME accounting from compiled HLO (VERDICT r4 #4/5).
+
+The pair/structure assertions in ``test_observability.py`` catch a
+missing collective; they cannot catch a silently-oversized one (e.g. a
+reduce-scatter regressing to a full all-gather + local slice, or a
+bucketing change doubling traffic). These tests parse every collective
+op's output shape out of the compiled HLO and assert total bytes per
+collective KIND against the analytic expectation for the parallelism
+scheme — the strongest multi-chip comm-efficiency signal available
+without hardware. Reference behavior being mirrored: the bucketed
+allreduce economics of ``apex/parallel/distributed.py:429-479`` (volume
+= parameter bytes, not 2x), the reduce-scatter/all-gather split of
+DistributedFusedAdam (``:1920``, ``:926``), and ring context
+parallelism's (cp-1)-hop kv rotation.
+
+Byte accounting convention: each collective is charged its OUTPUT buffer
+size (tuple outputs summed). For all-reduce that equals the payload; for
+all-gather the gathered (full) size; for reduce-scatter the shard size;
+for collective-permute the hopped buffer. Async start/done pairs are
+counted once (the ``-done`` op has the same result repeated; only
+``-start``-less or ``-start`` forms are charged).
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4, "u16": 2,
+    "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+_KINDS = ("all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+          "all-to-all")
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _shape_bytes(text: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(text):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def collective_bytes(hlo_text: str):
+    """{kind: (count, total_output_bytes)} over all collective ops in the
+    module text. '-done' halves of async pairs are skipped."""
+    out = {k: [0, 0] for k in _KINDS}
+    for line in hlo_text.splitlines():
+        m = re.search(r"=\s+(.*?)\s+([a-z0-9-]+)\(", line)
+        if not m:
+            continue
+        shapes, op = m.groups()
+        for kind in _KINDS:
+            if op == kind or op == kind + "-start":
+                out[kind][0] += 1
+                out[kind][1] += _shape_bytes(shapes)
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def _hlo(jitted, *args):
+    return jitted.lower(*args).compile().as_text()
+
+
+def _mesh(axis):
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
+TOL = 0.05  # 5% + 1 KB scalar slack on every analytic expectation
+
+
+def _assert_bytes(actual, expected, what):
+    assert abs(actual - expected) <= expected * TOL + 1024, (
+        f"{what}: {actual} bytes vs analytic {expected}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# TP=8: column+row linear pair, fwd+bwd
+# ---------------------------------------------------------------------------
+
+def test_tp_step_allreduce_volume():
+    """One TP=8 (column -> row) block, grad w.r.t. (x, wc, wr): exactly
+    two all-reduces of the [B, S=binned, H] activation — the row
+    forward's partial-sum reduce and the column backward's dx reduce
+    (copy_to transpose). Volume = 2 * B*T*H * 4 bytes; anything more
+    means a collective regressed to a bigger one."""
+    from apex_tpu.transformer.tensor_parallel import (
+        column_parallel_linear,
+        row_parallel_linear,
+    )
+
+    mesh = _mesh("tensor")
+    T, H = 64, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(ks[0], (T, H))
+    wc = jax.random.normal(ks[1], (256 // 8, H))
+    wr = jax.random.normal(ks[2], (H, 256 // 8))
+    tgt = jax.random.normal(ks[3], (T, H))
+
+    def f(x, wc, wr):
+        def loss(x, wc, wr):
+            y, _ = column_parallel_linear(
+                x, wc, axis_name="tensor", gather_output=False)
+            z, _ = row_parallel_linear(
+                jnp.tanh(y), wr, axis_name="tensor", input_is_parallel=True)
+            return jnp.mean((z - tgt) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(x, wc, wr)
+
+    g = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P("tensor"), P(None, "tensor")),
+        out_specs=(P(), P("tensor"), P(None, "tensor")), check_vma=True,
+    ))
+    vols = collective_bytes(_hlo(g, x, wc, wr))
+    expected = 2 * T * H * 4
+    _assert_bytes(vols["all-reduce"][1], expected, "TP all-reduce")
+    for kind in ("all-gather", "reduce-scatter"):
+        assert vols[kind][1] == 0, (kind, vols[kind])
+
+
+# ---------------------------------------------------------------------------
+# SP (Megatron sequence parallelism): gather/scatter pair, fwd+bwd
+# ---------------------------------------------------------------------------
+
+def test_sp_step_gather_scatter_volume():
+    """One SP column->row block, fwd+bwd. Analytic volume:
+
+    - all-gather: column fwd gathers the seq-scattered input ([S,B,H]
+      full out); the weight grad reuses the SAVED gathered activation
+      (an [S,B,H] residual, trading memory for one less gather than
+      Megatron's recompute-the-gather); the row bwd gathers d(out) —
+      2 full activations total.
+    - reduce-scatter: row fwd scatters its output and column bwd
+      scatters dx (the all-gather transpose) — 2 shard-sized outputs.
+    """
+    from apex_tpu.transformer.tensor_parallel import (
+        column_parallel_linear,
+        row_parallel_linear,
+    )
+
+    mesh = _mesh("tensor")
+    S, B, H = 32, 2, 128
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    x = jax.random.normal(ks[0], (S, B, H))
+    wc = jax.random.normal(ks[1], (256 // 8, H))
+    wr = jax.random.normal(ks[2], (H, 256 // 8))
+
+    def f(x, wc, wr):
+        def loss(x, wc, wr):
+            y, _ = column_parallel_linear(
+                x, wc, axis_name="tensor", gather_output=False,
+                sequence_parallel_enabled=True)
+            z, _ = row_parallel_linear(
+                jnp.tanh(y), wr, axis_name="tensor", input_is_parallel=True,
+                sequence_parallel_enabled=True)
+            return jnp.sum(z ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(x, wc, wr)
+
+    g = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P("tensor"), P("tensor"), P(None, "tensor")),
+        out_specs=(P("tensor"), P("tensor"), P(None, "tensor")),
+        check_vma=True,
+    ))
+    vols = collective_bytes(_hlo(g, x, wc, wr))
+    act_full = S * B * H * 4
+    act_shard = act_full // 8
+    _assert_bytes(vols["all-gather"][1], 2 * act_full, "SP all-gather")
+    _assert_bytes(vols["reduce-scatter"][1], 2 * act_shard,
+                  "SP reduce-scatter")
+    assert vols["all-reduce"][1] <= 1024, vols["all-reduce"]
+
+
+# ---------------------------------------------------------------------------
+# Ring context parallelism: kv rotation volume
+# ---------------------------------------------------------------------------
+
+def test_ring_cp_permute_volume():
+    """Ring attention fwd+bwd at cp=8. Naively the backward re-rotates
+    (k, v) alongside its (dk, dv) accumulators — but the backward's kv
+    chain replays the forward's exactly, and XLA CSEs them into ONE
+    shared rotation. Analytic (post-CSE) volume: (k, v) hop cp-1 times
+    (shared), (dk, dv) hop cp-1 times plus the final home hop = 30
+    buffers at cp=8, each one [b, n, s_loc, d] f32 collective-permute.
+    This pin is exactly the kind of thing the pair assertions can't
+    see: a CSE regression would double the kv traffic with the same op
+    STRUCTURE."""
+    from apex_tpu.transformer.context_parallel import ring_attention
+
+    mesh = _mesh("cp")
+    cp = 8
+    b, n, s_glob, d = 1, 2, 128, 8
+    s_loc = s_glob // cp  # per-device shard: the hopped buffer size
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (b, n, s_glob, d))
+    k = jax.random.normal(ks[1], (b, n, s_glob, d))
+    v = jax.random.normal(ks[2], (b, n, s_glob, d))
+
+    def f(q, k, v):
+        def loss(q, k, v):
+            o = ring_attention(
+                q, k, v, axis_name="cp", causal=True, interpret=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    g = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, None, "cp"), P(None, None, "cp"),
+                  P(None, None, "cp")),
+        out_specs=(P(None, None, "cp"), P(None, None, "cp"),
+                   P(None, None, "cp")),
+        check_vma=True,
+    ))
+    vols = collective_bytes(_hlo(g, q, k, v))
+    buf = b * n * s_loc * d * 4  # f32 inputs; dk/dv accumulators f32 too
+    kv_shared = 2 * (cp - 1) * buf      # one CSE'd (k, v) rotation
+    dkv = 2 * (cp - 1) * buf + 2 * buf  # (dk, dv) + final home hop
+    _assert_bytes(vols["collective-permute"][1], kv_shared + dkv,
+                  "ring CP hops")
+    assert vols["collective-permute"][0] == 4 * cp - 2, vols
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-2 (DistributedFusedAdam): reduce-scatter + all-gather split
+# ---------------------------------------------------------------------------
+
+def test_zero2_step_volume():
+    """One DistributedFusedAdam step at dp=8: grads reduce-scatter to a
+    1/8 shard, updated params all-gather back — the defining ZeRO-2
+    economics (vs DDP's full all-reduce = 2x the reduce-scatter volume
+    at equal dtype). Volumes derive from the padded flat size."""
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+
+    mesh = _mesh("data")
+    kp = jax.random.split(jax.random.PRNGKey(3), 2)
+    params = {
+        "w": jax.random.normal(kp[0], (100, 64), jnp.float32),
+        "b": jax.random.normal(kp[1], (100,), jnp.float32),
+    }
+    opt = DistributedFusedAdam(
+        lr=1e-3, distributed_size=8, distributed_axis="data")
+    layout = opt.layout_for(params)
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
+
+    def step(grads, state, params):
+        return opt.step(grads, state, params)
+
+    g = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), opt.state_specs(), P()),
+        out_specs=(P(), opt.state_specs()), check_vma=False,
+    ))
+    vols = collective_bytes(_hlo(g, grads, state, params))
+    flat_bytes = layout.padded * 4  # f32 grad-sync and param-sync
+    _assert_bytes(vols["reduce-scatter"][1], flat_bytes // 8,
+                  "ZeRO-2 reduce-scatter")
+    _assert_bytes(vols["all-gather"][1], flat_bytes, "ZeRO-2 all-gather")
+    # the whole point vs DDP: total sync volume ~= 1.125x param bytes,
+    # NOT the 2x of reduce-scatter-as-all-reduce + gather-as-broadcast
+    total = vols["reduce-scatter"][1] + vols["all-gather"][1]
+    assert total <= flat_bytes * 1.25 + 1024, total
