@@ -276,3 +276,33 @@ def test_zero2_step_volume():
     # NOT the 2x of reduce-scatter-as-all-reduce + gather-as-broadcast
     total = vols["reduce-scatter"][1] + vols["all-gather"][1]
     assert total <= flat_bytes * 1.25 + 1024, total
+
+
+# ---------------------------------------------------------------------------
+# HLO-parse helpers shared with tools/op_breakdown.py
+# ---------------------------------------------------------------------------
+
+def test_shape_bytes_parser():
+    """The byte parser behind the volume accounting: dtype table, dims
+    products, tuples, and unknown dtypes ignored."""
+    assert _shape_bytes("f32[4,128]{1,0}") == 4 * 128 * 4
+    assert _shape_bytes("bf16[8,16,1024,64]{3,2,1,0:T(8,128)(2,1)}") == \
+        8 * 16 * 1024 * 64 * 2
+    assert _shape_bytes("(f32[2,4]{1,0}, s32[8]{0})") == 2 * 4 * 4 + 8 * 4
+    assert _shape_bytes("token[]") == 0
+    assert _shape_bytes("pred[16]{0}") == 16
+
+
+def test_collective_bytes_counts_start_once():
+    """Async pairs must be charged once (the -start op), never the
+    -done half."""
+    hlo = """
+  %ar = f32[4,4]{1,0} all-reduce(%x), replica_groups={}
+  %ag-s = (f32[8]{0}, f32[64]{0}) all-gather-start(%y), dimensions={0}
+  %ag-d = f32[64]{0} all-gather-done(%ag-s)
+  %cp = bf16[2,2]{1,0} collective-permute(%z), source_target_pairs={{0,1}}
+"""
+    vols = collective_bytes(hlo)
+    assert vols["all-reduce"] == (1, 64)
+    assert vols["all-gather"] == (1, (8 + 64) * 4)
+    assert vols["collective-permute"] == (1, 8)
