@@ -6,18 +6,21 @@ Reference: ``apex/contrib/optimizers/`` — ``DistributedFusedAdam`` (ZeRO-2,
 FusedAdam/FusedSGD and an ``FP16_Optimizer`` wrapper for them
 (``contrib/optimizers/fp16_optimizer.py``).
 
-The legacy trio were older duplicates of ``apex.optimizers`` kept for
-backward compatibility; here they are re-exports of the maintained
-implementations (``apex_tpu.optimizers`` / ``apex_tpu.fp16_utils``) so legacy
-import paths keep working without a second copy of the math.
+The legacy trio (``FusedAdam``/``FusedSGD`` + their ``FP16_Optimizer``)
+differ from the maintained packages in their STEP surface — explicit
+grads divided by a caller ``scale``, combined-scale clipping from
+precomputed ``grad_norms``, reduced-precision ``output_params`` copies,
+``eps_inside_sqrt`` — implemented in ``legacy.py`` as thin subclasses of
+the maintained fused updates. ``FP16_Optimizer`` re-exports the full
+``fp16_utils`` implementation (the reference contrib one is an
+explicitly-cutdown copy of it, ``fp16_optimizer.py:6``).
 """
 from .distributed_fused_adam import DistributedFusedAdam, DistributedFusedAdamState
 from .distributed_fused_lamb import DistributedFusedLAMB, DistributedFusedLAMBState
+from .legacy import LegacyFusedAdam as FusedAdam  # noqa: F401
+from .legacy import LegacyFusedSGD as FusedSGD  # noqa: F401
 
-# legacy aliases (reference apex/contrib/optimizers/{fused_adam,fused_sgd,
-# fp16_optimizer}.py — deprecated duplicates of the core packages)
-from ...optimizers.fused_adam import FusedAdam  # noqa: F401
-from ...optimizers.fused_sgd import FusedSGD  # noqa: F401
+# the reference contrib package has no LAMB duplicate; kept importable
 from ...optimizers.fused_lamb import FusedLAMB  # noqa: F401
 from ...fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
 
