@@ -11,7 +11,8 @@ from precomputed ``grad_norms`` against ``max_grad_norm``
 norms of the SCALED grads), and a reduced-precision copy of the updated
 weights can be emitted alongside (``output_params``). The legacy Adam
 also exposes ``eps_inside_sqrt`` (``fused_adam_cuda`` kernel mode 0:
-``denom = sqrt(v_hat + eps)`` instead of ``sqrt(v_hat) + eps``).
+``denom = sqrt(v + eps)`` instead of mode 1's ``sqrt(v) + eps`` — raw
+second moment in both, see the next paragraph).
 
 The legacy Adam kernel's update differs from BOTH maintained modes
 (``fused_adam_cuda_kernel.cu:60-70``): the denominator comes from the
@@ -55,6 +56,15 @@ def _output_copy(params, output_params_dtype):
     return jax.tree_util.tree_map(
         lambda p: p.astype(output_params_dtype), params
     )
+
+
+def _legacy_returns(new_params, new_state, output_params_dtype):
+    """The shared legacy return contract: 2-tuple, or 3-tuple with the
+    reduced-precision copy when ``output_params_dtype`` is given."""
+    out = _output_copy(new_params, output_params_dtype)
+    if out is not None:
+        return new_params, new_state, out
+    return new_params, new_state
 
 
 class LegacyFusedAdam(FusedAdam):
@@ -134,10 +144,7 @@ class LegacyFusedAdam(FusedAdam):
         new_params, new_state = super().step(
             grads, state, params, lr=lr, grad_scale=combined
         )
-        out = _output_copy(new_params, output_params_dtype)
-        if out is not None:
-            return new_params, new_state, out
-        return new_params, new_state
+        return _legacy_returns(new_params, new_state, output_params_dtype)
 
 
 class LegacyFusedSGD(FusedSGD):
@@ -175,7 +182,4 @@ class LegacyFusedSGD(FusedSGD):
         new_params, new_state = super().step(
             grads, state, params, lr=lr, grad_scale=scale
         )
-        out = _output_copy(new_params, output_params_dtype)
-        if out is not None:
-            return new_params, new_state, out
-        return new_params, new_state
+        return _legacy_returns(new_params, new_state, output_params_dtype)

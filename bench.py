@@ -110,6 +110,28 @@ def train_flops_per_step(L, h, ffn, V, b, s, causal=True):
     return 3 * (L * per_layer + head)  # bwd = 2x fwd
 
 
+def _retry_transient(fn, attempts=3, tag="bench leg"):
+    """Re-run a bench leg when the axon remote-compile transport flakes
+    (HTTP 500 / 'response body closed' mid-compile — observed ~1/20 legs
+    on long runs). Only transport-class errors retry; real failures
+    (OOM, invalid argument) surface immediately."""
+    import sys as _sys
+
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            msg = str(e)
+            transient = "remote_compile" in msg and (
+                "response body closed" in msg or "HTTP 500" in msg
+                or "read body" in msg
+            )
+            if not transient or attempt == attempts - 1:
+                raise
+            print(f"{tag}: transient compile-transport error, retrying "
+                  f"({attempt + 1}/{attempts - 1})", file=_sys.stderr)
+
+
 def _timed_steps(step_fn, state, iters):
     """Run chained steps via the Megatron-style Timers (the reference's
     ``_Timer``/``Timers`` instrumentation, ``pipeline_parallel/_timers.py``);
@@ -457,8 +479,10 @@ def main() -> None:
 
     peak, recognised, hbm_gbps, hbm_recognised = detect_peaks()
 
-    step_s, final_loss, flops = bench_gpt(
-        iters, batch, seq, remat, capture_state=not fast)
+    step_s, final_loss, flops = _retry_transient(
+        lambda: bench_gpt(iters, batch, seq, remat,
+                          capture_state=not fast),
+        tag="gpt headline")
     if not math.isfinite(final_loss):
         raise SystemExit(f"final loss is not finite: {final_loss}")
     # profile the HEADLINE step; gpt_op_breakdown releases the retained
@@ -483,7 +507,9 @@ def main() -> None:
         # delta instead of the kernel.
         os.environ["APEX_TPU_DISABLE_FLASH"] = "1"
         try:
-            xla_step_s, _, _ = bench_gpt(iters, batch, seq, "selective")
+            xla_step_s, _, _ = _retry_transient(
+                lambda: bench_gpt(iters, batch, seq, "selective"),
+                tag="xla-attn leg")
         finally:
             del os.environ["APEX_TPU_DISABLE_FLASH"]
         if remat == "selective":
@@ -491,14 +517,17 @@ def main() -> None:
             # second full compile for an identical measurement
             flash_step_s = step_s
         else:
-            flash_step_s, _, _ = bench_gpt(iters, batch, seq, "selective")
+            flash_step_s, _, _ = _retry_transient(
+                lambda: bench_gpt(iters, batch, seq, "selective"),
+                tag="flash leg")
         vs_xla_attention = xla_step_s / flash_step_s  # >1: flash faster
 
     bert = None
     if not fast:
         b_batch = int(os.environ.get("BENCH_BERT_BATCH", "16"))
         b_seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
-        b_step, b_loss, b_flops = bench_bert_lamb(iters, b_batch, b_seq)
+        b_step, b_loss, b_flops = _retry_transient(
+            lambda: bench_bert_lamb(iters, b_batch, b_seq), tag="bert")
         if not math.isfinite(b_loss):
             raise SystemExit(f"BERT final loss is not finite: {b_loss}")
         b_tflops = b_flops / b_step / 1e12
@@ -544,8 +573,8 @@ def main() -> None:
         ]
 
         def resnet_point(r_batch):
-            r_step, r_loss, r_flops, r_bytes = bench_resnet_o2(
-                iters, r_batch)
+            r_step, r_loss, r_flops, r_bytes = _retry_transient(
+                lambda: bench_resnet_o2(iters, r_batch), tag="resnet")
             if not math.isfinite(r_loss):
                 raise SystemExit(
                     f"ResNet final loss is not finite: {r_loss}")
@@ -628,7 +657,8 @@ def main() -> None:
                   file=_sys.stderr)
             fp8_ratio = None
         try:
-            f_step, f_loss = bench_gpt_fp8(iters, batch, seq)
+            f_step, f_loss = _retry_transient(
+                lambda: bench_gpt_fp8(iters, batch, seq), tag="fp8 model")
             if not math.isfinite(f_loss):
                 raise RuntimeError(f"fp8 GPT loss not finite: {f_loss}")
             fp8_model = {
