@@ -196,3 +196,17 @@ def test_imagenet_opt_level_cross_product(monkeypatch, tmp_path, capsys,
     out = capsys.readouterr().out
     assert "Epoch: [0][2/3]" in out
     assert np.isfinite(prec1)
+
+
+def test_fp8_training_example():
+    """examples/gpt/fp8_training: the full e4m3/e5m2 delayed-scaling loop
+    trains (loss decreases) and the scales calibrate off the defaults."""
+    out = _run_example(("gpt", "fp8_training"),
+                 ["--cpu", "1", "--steps", "12", "--layers", "2",
+                  "--hidden", "64", "--heads", "4", "--vocab", "128",
+                  "--seq", "64"])
+    assert "final loss" in out
+    losses = [float(m) for m in re.findall(r"loss ([0-9.]+)", out)]
+    assert losses[-1] < losses[0]
+    scales = [float(m) for m in re.findall(r"x_scale ([0-9.eE+-]+)", out)]
+    assert scales[-1] != 1.0  # delayed scaling derived a real scale
