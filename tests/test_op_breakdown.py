@@ -1,0 +1,38 @@
+"""Unit tests for the xplane op-breakdown helpers (tools/op_breakdown.py).
+
+The profiling capture itself needs a real TPU; the parsing/classification
+logic is pure and pinned here so a refactor cannot silently misbucket the
+published bench breakdown.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.op_breakdown import _category, _short_op_name  # noqa: E402
+
+
+def test_short_op_name_strips_hlo_decoration():
+    assert _short_op_name(
+        "%convolution_tanh_fusion.3 = bf16[4096,4096]{1,0} fusion(...)"
+    ) == "convolution_tanh_fusion"
+    assert _short_op_name("%while.7 = (s32[], f32[8]) while(...)") == "while"
+    assert _short_op_name(
+        "%apex_tpu_flash_fwd.65 = (bf16[8,16,1024,64]) custom-call(...)"
+    ) == "apex_tpu_flash_fwd"
+    # no ' = ' (bare name) and no trailing index both survive
+    assert _short_op_name("%copy-done") == "copy-done"
+    assert _short_op_name("fusion") == "fusion"
+
+
+def test_category_buckets():
+    assert _category("apex_tpu_flash_fwd") == "attention-kernel"
+    assert _category("apex_tpu.flash_attention") == "attention-kernel"
+    assert _category("convolution_add_fusion") == "matmul/conv"
+    assert _category("all-reduce-start") == "collective"
+    assert _category("collective-permute") == "collective"
+    assert _category("bitcast_dynamic-update-slice_fusion") == "data-movement"
+    assert _category("copy") == "data-movement"
+    assert _category("exponential_reduce_fusion") == "reduce"
+    assert _category("select_add_fusion") == "fusion(elementwise)"
+    assert _category("iota") == "other"
