@@ -8,6 +8,7 @@ asserts including backward.
 import dataclasses
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import pytest
 
@@ -481,3 +482,24 @@ def test_bias_folded_full_row_mask_returns_zeros(block_k, bias_grad):
     for g in grads:
         assert jnp.all(jnp.isfinite(g))
     assert jnp.abs(grads[0][:, :, 5]).max() == 0.0  # dq of the masked row
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_explicit_bwd_blocks_match_default(causal):
+    """The bwd_block_q/bwd_block_k hooks (round-5: fwd and bwd tiles can
+    diverge) must produce the same gradients as the default tiling —
+    guards the custom-vjp nondiff-arg plumbing."""
+    q, k, v = _qkv(jax.random.PRNGKey(9), s=64, d=32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_def = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=32, block_k=32)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_exp = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=32, block_k=32,
+        bwd_block_q=16, bwd_block_k=16)), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_def, g_exp, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, err_msg=name)
