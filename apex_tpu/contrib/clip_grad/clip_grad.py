@@ -18,7 +18,12 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 
+from ...multi_tensor_apply.packing import DEFAULT_CHUNK
 from ...ops.multi_tensor import multi_tensor_l2norm
+from ...ops.packed_optimizer import (
+    multi_tensor_l2norm_flat,
+    multi_tensor_scale_flat,
+)
 
 Pytree = Any
 
@@ -70,3 +75,32 @@ def clip_grad_norm_(
         lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads
     )
     return clipped, total_norm
+
+
+def clip_grad_norm_flat(
+    flat_grads: jax.Array,
+    max_norm: float,
+    *,
+    chunk_size: int = DEFAULT_CHUNK,
+    use_kernel=None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """2-norm clipping over a packed flat gradient buffer: two chunked
+    sweeps (norm partials, then scale) instead of a per-leaf tree walk —
+    the companion to the ``packed=True`` optimizers, and the flat-buffer
+    spelling of the reference's fused
+    ``multi_tensor_l2norm`` + ``multi_tensor_scale`` pair.
+
+    Returns ``(clipped_flat, total_norm)`` with total_norm the pre-clip
+    norm (padding in the buffer must be zero, as ``PackSpec.pack``
+    guarantees, so it contributes nothing).
+    """
+    kw = dict(chunk_size=chunk_size, use_kernel=use_kernel,
+              interpret=interpret)
+    total_norm, _ = multi_tensor_l2norm_flat(flat_grads, **kw)
+    clip_coef = jnp.minimum(float(max_norm) / (total_norm + 1e-6), 1.0)
+    clipped, _ = multi_tensor_scale_flat(flat_grads, clip_coef, **kw)
+    return clipped, total_norm
+
+
+clip_grad_norm_flat.accepts_chunk_size = True

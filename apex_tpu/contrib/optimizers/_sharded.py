@@ -22,6 +22,15 @@ for API parity and documented no-ops.
 buffer, shard geometry, and per-position leaf ids (the LAMB per-tensor
 trust-ratio machinery; reference ``multi_tensor_apply.cuh:16-27`` solved the
 same "which tensor does this element belong to" problem with chunk metadata).
+
+The single-device packed optimizers grew a sibling of this layout with
+per-leaf ROW alignment and chunked Pallas kernels
+(``apex_tpu.multi_tensor_apply.packing.PackSpec`` +
+``apex_tpu.ops.packed_optimizer``). The shard-local update here still
+relies on XLA fusion over the flat shard; running the packed kernels on
+the ``(shard_size,)`` buffers inside ``shard_map`` is the natural
+follow-on (ROADMAP "packed sharded buckets") — the layouts differ only
+in alignment, so the migration is offset bookkeeping, not kernel work.
 """
 from __future__ import annotations
 

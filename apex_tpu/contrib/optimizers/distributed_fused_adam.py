@@ -15,7 +15,15 @@ with backward via hooks (``:875-924, :1920``)                (XLA overlaps)
 optional all-reduce over the redundant group (``:1920``)     ``lax.psum`` over
                                                              ``redundant_axis``
 shard-local multi-tensor Adam kernel (``:2580``)             shard-local fused
-                                                             update (XLA-fused)
+                                                             update (XLA-fused;
+                                                             the chunked Pallas
+                                                             kernel of the
+                                                             single-device
+                                                             ``packed=True``
+                                                             path is the
+                                                             planned upgrade —
+                                                             see ``_sharded``
+                                                             module docstring)
 param ``all_gather`` overlapped with next forward            ``lax.all_gather``
 (``:926-960``)                                               (XLA overlaps)
 grad-norm / clip / unscale integration (``:2289-2426``)      ``max_grad_norm``

@@ -58,6 +58,27 @@ class SoftmaxCrossEntropyLoss:
         )
 
 
+def _maybe_scan(body, carry, xs, unroll):
+    """``lax.scan`` or a Python-unrolled equivalent (stacked ys).
+
+    Unrolling replaces the scan while-loop's dynamic-slice xs reads and
+    dynamic-update-slice ys writes with plain slices/concatenates — the
+    candidate fix for the GPT bench's ``bitcast_dynamic-update-slice``
+    data-movement bucket (see ``docs/dus_bucket.md``). Numerics are
+    identical; only the loop lowering changes.
+    """
+    if not unroll:
+        return jax.lax.scan(body, carry, xs)
+    nc = jax.tree_util.tree_leaves(xs)[0].shape[0]
+    ys = []
+    for i in range(nc):
+        xi = jax.tree_util.tree_map(lambda a: a[i], xs)
+        carry, y = body(carry, xi)
+        ys.append(y)
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *ys)
+    return carry, stacked
+
+
 def lm_head_cross_entropy(
     hidden: jax.Array,  # [N, h] pre-head activations (any float dtype)
     head_weight: jax.Array,  # [V, h] (tied-embedding layout)
@@ -65,6 +86,7 @@ def lm_head_cross_entropy(
     *,
     chunk_size: int = 2048,
     save_logits_dtype=None,
+    unroll: bool = False,
 ) -> jax.Array:
     """Chunk-fused LM-head GEMM + cross entropy: per-row losses WITHOUT
     materialising the full ``[N, V]`` logits tensor.
@@ -92,6 +114,14 @@ def lm_head_cross_entropy(
     one fewer GEMM pass + one fewer reduce pass per chunk; measured ~5
     ms/step on the GPT-2 345M v5e bench. Logit precision: bf16 keeps
     |logit| <= ~40 to ~0.3% relative, well inside half-softmax parity.
+
+    ``unroll=True`` unrolls the chunk loop (Python loop + concatenate
+    instead of a scan's dynamic-update-slice stacking). For THIS remat
+    variant it was measured ~6 ms/step slower on v5e (several fp32
+    ``[chunk, V]`` logit blocks go live concurrently); for the
+    saved-logits variant the ``[N, V]`` buffer is materialised either
+    way, so unrolling costs no extra memory and is the A/B knob for the
+    scan-lowering data-movement bucket (``docs/dus_bucket.md``).
     """
     n, h = hidden.shape
     if n % chunk_size:
@@ -99,7 +129,7 @@ def lm_head_cross_entropy(
     if save_logits_dtype is not None:
         return _lm_head_ce_saved(
             hidden, head_weight, labels, chunk_size,
-            jnp.dtype(save_logits_dtype),
+            jnp.dtype(save_logits_dtype), unroll,
         )
     hc = hidden.reshape(n // chunk_size, chunk_size, h)
     lc = labels.reshape(n // chunk_size, chunk_size)
@@ -121,21 +151,22 @@ def lm_head_cross_entropy(
     # NB: measured on v5e (345M bench): unroll=True here is ~6 ms/step
     # SLOWER — unrolling lets several [chunk, V] fp32 logit blocks go live
     # concurrently and the memory pressure costs more than the rolled
-    # scan's slice overhead. Keep the rolled scan.
-    _, losses = jax.lax.scan(body, None, (hc, lc))
+    # scan's slice overhead. Keep the rolled scan by default.
+    _, losses = _maybe_scan(body, None, (hc, lc), unroll)
     return losses.reshape(n)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _lm_head_ce_saved(hidden, head_weight, labels, chunk_size, logits_dtype):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _lm_head_ce_saved(hidden, head_weight, labels, chunk_size, logits_dtype,
+                      unroll=False):
     losses, _ = _lm_head_ce_saved_fwd(
-        hidden, head_weight, labels, chunk_size, logits_dtype
+        hidden, head_weight, labels, chunk_size, logits_dtype, unroll
     )
     return losses
 
 
 def _lm_head_ce_saved_fwd(hidden, head_weight, labels, chunk_size,
-                          logits_dtype):
+                          logits_dtype, unroll=False):
     n, h = hidden.shape
     nc = n // chunk_size
     hc = hidden.reshape(nc, chunk_size, h)
@@ -158,11 +189,11 @@ def _lm_head_ce_saved_fwd(hidden, head_weight, labels, chunk_size,
         gold = jnp.take_along_axis(lf, lrow[:, None], axis=-1)[:, 0]
         return carry, (lse - gold, logits, lse)
 
-    _, (losses, saved_logits, lse) = jax.lax.scan(body, None, (hc, lc))
+    _, (losses, saved_logits, lse) = _maybe_scan(body, None, (hc, lc), unroll)
     return losses.reshape(n), (hidden, head_weight, labels, saved_logits, lse)
 
 
-def _lm_head_ce_saved_bwd(chunk_size, logits_dtype, res, g):
+def _lm_head_ce_saved_bwd(chunk_size, logits_dtype, unroll, res, g):
     hidden, head_weight, labels, saved_logits, lse = res
     n, h = hidden.shape
     nc = n // chunk_size
@@ -193,7 +224,7 @@ def _lm_head_ce_saved_bwd(chunk_size, logits_dtype, res, g):
         return dw_acc, dh.astype(hidden.dtype)
 
     dw0 = jnp.zeros(head_weight.shape, jnp.float32)
-    dw, dhc = jax.lax.scan(body, dw0, (hc, lc, gc, saved_logits, lse))
+    dw, dhc = _maybe_scan(body, dw0, (hc, lc, gc, saved_logits, lse), unroll)
     return (
         dhc.reshape(n, h).astype(hidden.dtype),
         dw.astype(head_weight.dtype),

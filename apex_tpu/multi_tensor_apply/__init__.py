@@ -1,1 +1,2 @@
 from .multi_tensor_apply import MultiTensorApply, multi_tensor_applier  # noqa: F401
+from .packing import DEFAULT_CHUNK, ROW, PackSpec  # noqa: F401

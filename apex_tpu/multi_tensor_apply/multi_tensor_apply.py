@@ -1,11 +1,17 @@
 """API-parity wrapper for fused multi-tensor ops.
 
 Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30`` — a thin
-callable that forwards ``(chunk_size, noop_flag, tensor_lists, *args)`` into an
-``amp_C`` CUDA op. On TPU there is no launch overhead to amortise and no chunk
-size: every op in ``apex_tpu.ops`` is a pure jittable function over pytrees,
-and XLA does the fusion. The wrapper survives purely so reference-style call
-sites keep working.
+callable that forwards ``(chunk_size, noop_flag, tensor_lists, *args)`` into
+an ``amp_C`` CUDA op. Two families of ops exist on this side:
+
+- pytree ops (``apex_tpu.ops.multi_tensor``): pure jittable functions over
+  pytrees, fused by XLA; no chunking machinery.
+- flat-buffer ops (``apex_tpu.ops.packed_optimizer``): chunked Pallas
+  kernels over contiguous 1-D buffers (see
+  ``apex_tpu.multi_tensor_apply.packing``). These carry
+  ``accepts_chunk_size = True`` and the applier forwards its
+  ``chunk_size`` into their kernel grid — the CUDA chunking contract,
+  no longer ignored.
 """
 from __future__ import annotations
 
@@ -13,18 +19,23 @@ from __future__ import annotations
 class MultiTensorApply:
     """Callable forwarding to a functional multi-tensor op.
 
-    ``chunk_size`` is accepted and ignored (XLA tiles internally). The op is
-    called as ``op(*tensor_lists_and_args)`` and its return value — typically
-    ``(outputs, found_inf)`` — is passed straight through.
+    The op is called as ``op(*tensor_lists_and_args)`` and its return
+    value — typically ``(outputs, found_inf)`` — is passed straight
+    through. For flat-buffer ops (marked ``accepts_chunk_size``) the
+    applier's ``chunk_size`` is injected as a keyword, sizing the kernel
+    grid's per-step chunk exactly like the CUDA launches; pytree ops
+    ignore chunking (XLA tiles internally).
     """
 
     available = True
     warned = False
 
     def __init__(self, chunk_size: int = 2048 * 32):
-        self.chunk_size = chunk_size
+        self.chunk_size = int(chunk_size)
 
     def __call__(self, op, *args, **kwargs):
+        if getattr(op, "accepts_chunk_size", False):
+            kwargs.setdefault("chunk_size", self.chunk_size)
         return op(*args, **kwargs)
 
 

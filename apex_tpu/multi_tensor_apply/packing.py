@@ -1,0 +1,175 @@
+"""Flat-buffer packing: the treedef/offset bookkeeping behind the packed
+multi-tensor optimizer path.
+
+Reference: the CUDA ``multi_tensor_apply`` streams *lists of tensor
+pointers* through fixed-size chunks (``csrc/multi_tensor_apply.cuh:16-133``)
+and ``DistributedFusedAdam`` goes further, flattening params into
+contiguous fixed-size buckets (``distributed_fused_adam.py:273-283``) so
+one kernel launch sweeps the whole optimizer state. A Pallas TPU grid has
+no pointer lists — the equivalent is the bucket design: every pytree in
+the optimizer protocol (grads, moments, fp32 masters, param outputs) is
+packed into ONE contiguous 1-D buffer per dtype group, and the kernels
+grid over fixed-size chunks of it.
+
+:class:`PackSpec` is the static host-side bookkeeping (treedef, shapes,
+per-leaf offsets) — an alignment-aware sibling of
+``contrib.optimizers._sharded.ShardedLayout``. The extra constraint here:
+each leaf's offset is aligned to ``ROW`` (= 8 sublanes x 128 lanes, one
+fp32 vreg tile), so when the flat buffer is viewed as ``(rows, ROW)``
+every row belongs to exactly ONE leaf. That makes per-tensor reductions
+(LAMB trust ratios, NovoGrad layer-wise moments) a cheap
+``segment_sum`` over per-row partials — the role the CUDA side's
+chunk->tensor metadata tables played (``multi_tensor_apply.cuh:16-27``).
+
+Padding is always ZERO and the kernels preserve that invariant (a zero
+gradient leaves a zero moment/param untouched for every supported
+update rule), so norms over the padded buffer equal norms over the tree.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+# One fp32 vector register tile: 8 sublanes x 128 lanes. Leaf offsets are
+# aligned to this so (rows, ROW)-shaped kernel blocks never straddle a
+# leaf boundary.
+ROW = 8 * 128
+
+# The reference's default chunk: 2048*32 elements
+# (``apex/multi_tensor_apply/multi_tensor_apply.py``, every optimizer ctor).
+DEFAULT_CHUNK = 2048 * 32
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+class PackSpec:
+    """Static pytree <-> aligned flat buffer map.
+
+    Hashable and comparable so it can ride through ``jit`` as auxiliary
+    pytree data (it is the ``aux_data`` of :class:`PackedState`).
+
+    ``chunk_size`` is the kernel chunk contract: ``total`` is padded up to
+    a multiple of it, so a grid of ``total // chunk_size`` fixed-size
+    chunks tiles the buffer exactly (the CUDA chunking contract).
+    """
+
+    def __init__(self, params_template: Pytree, align: int = ROW,
+                 chunk_size: int = DEFAULT_CHUNK):
+        if align % ROW:
+            raise ValueError(f"align ({align}) must be a multiple of {ROW}")
+        chunk_size = _round_up(int(chunk_size), align)
+        leaves, treedef = jax.tree_util.tree_flatten(params_template)
+        if not leaves:
+            raise ValueError("cannot build a PackSpec over an empty pytree")
+        self.treedef = treedef
+        self.shapes: Tuple[Tuple[int, ...], ...] = tuple(
+            tuple(l.shape) for l in leaves)
+        self.dtypes: Tuple[np.dtype, ...] = tuple(
+            jnp.dtype(l.dtype) for l in leaves)
+        self.sizes: Tuple[int, ...] = tuple(
+            int(np.prod(s)) if s else 1 for s in self.shapes)
+        self.padded_sizes: Tuple[int, ...] = tuple(
+            _round_up(n, align) for n in self.sizes)
+        offs = np.concatenate([[0], np.cumsum(self.padded_sizes)])
+        self.offsets: Tuple[int, ...] = tuple(int(o) for o in offs[:-1])
+        self.n_leaves = len(leaves)
+        self.align = align
+        self.chunk_size = chunk_size
+        self.total = _round_up(int(offs[-1]), chunk_size)
+        self.n_rows = self.total // ROW
+
+    # -- identity (jit static-arg / aux-data requirements) -----------------
+    def _key(self):
+        return (self.treedef, self.shapes,
+                tuple(str(d) for d in self.dtypes),
+                self.align, self.chunk_size)
+
+    def __eq__(self, other):
+        return isinstance(other, PackSpec) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return (f"PackSpec(n_leaves={self.n_leaves}, total={self.total}, "
+                f"chunk_size={self.chunk_size})")
+
+    # -- dtype bookkeeping -------------------------------------------------
+    def common_dtype(self, fallback=jnp.float32) -> np.dtype:
+        """The single dtype of the template leaves, or ``fallback`` when
+        the template mixes dtypes (the flat buffer must be homogeneous;
+        :meth:`unpack` casts each leaf back)."""
+        uniq = set(self.dtypes)
+        return self.dtypes[0] if len(uniq) == 1 else jnp.dtype(fallback)
+
+    # -- pytree <-> flat ---------------------------------------------------
+    def check(self, tree: Pytree) -> None:
+        leaves = jax.tree_util.tree_leaves(tree)
+        if len(leaves) != self.n_leaves or tuple(
+                tuple(l.shape) for l in leaves) != self.shapes:
+            raise ValueError(
+                "pytree does not match PackSpec (same optimizer instance "
+                f"reused for a different model?): spec {self!r} vs "
+                f"{len(leaves)} leaves")
+
+    def pack(self, tree: Pytree, dtype: Optional[Any] = None) -> jax.Array:
+        """Ravel + per-leaf zero-pad + concat to ``(total,)``.
+
+        One XLA concatenate — a single write sweep, fused with the casts.
+        ``dtype=None`` packs in the leaves' common dtype (fp32 when mixed).
+        """
+        self.check(tree)
+        dtype = jnp.dtype(dtype) if dtype is not None else self.common_dtype()
+        leaves = jax.tree_util.tree_leaves(tree)
+        pieces = []
+        for leaf, n, pn in zip(leaves, self.sizes, self.padded_sizes):
+            pieces.append(leaf.reshape(-1).astype(dtype))
+            if pn != n:
+                pieces.append(jnp.zeros((pn - n,), dtype))
+        tail = self.total - sum(self.padded_sizes)
+        if tail:
+            pieces.append(jnp.zeros((tail,), dtype))
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    def unpack(self, flat: jax.Array, cast: bool = True) -> Pytree:
+        """``(total,)`` -> pytree; each leaf cast back to its template
+        dtype unless ``cast=False``."""
+        leaves = []
+        for i in range(self.n_leaves):
+            o = self.offsets[i]
+            piece = jax.lax.slice(flat, (o,), (o + self.sizes[i],))
+            piece = piece.reshape(self.shapes[i])
+            leaves.append(piece.astype(self.dtypes[i]) if cast else piece)
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def zeros(self, dtype=jnp.float32) -> jax.Array:
+        return jnp.zeros((self.total,), dtype)
+
+    # -- per-row metadata (the chunk->tensor tables) -----------------------
+    def row_leaf_ids(self) -> np.ndarray:
+        """int32 ``(n_rows,)``: leaf index owning each ROW-sized row;
+        padding rows (inter-leaf and tail) get segment ``n_leaves``. Host
+        numpy — feed to ``segment_sum(..., num_segments=n_leaves + 1)``
+        and drop the last segment."""
+        ids = np.full((self.n_rows,), self.n_leaves, np.int32)
+        for i in range(self.n_leaves):
+            r0 = self.offsets[i] // ROW
+            # rows containing any real element of leaf i (the tail row may
+            # be partially padding; pads are zero so reductions are exact)
+            r1 = (self.offsets[i] + self.sizes[i] + ROW - 1) // ROW
+            ids[r0:r1] = i
+        return ids
+
+    def valid_mask(self) -> np.ndarray:
+        """bool ``(total,)``: True at real positions, False at padding."""
+        mask = np.zeros((self.total,), bool)
+        for o, n in zip(self.offsets, self.sizes):
+            mask[o:o + n] = True
+        return mask

@@ -17,6 +17,17 @@ from .multi_tensor import (  # noqa: F401
     l2norm,
     has_inf_or_nan,
 )
+from .packed_optimizer import (  # noqa: F401
+    multi_tensor_axpby_flat,
+    multi_tensor_l2norm_flat,
+    multi_tensor_scale_flat,
+    packed_adam_apply,
+    packed_lamb_stage1,
+    packed_novograd_apply,
+    packed_row_reduce,
+    packed_scale_update,
+    packed_sgd_apply,
+)
 from .flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_sbhd,

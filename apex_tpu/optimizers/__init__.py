@@ -13,3 +13,4 @@ from .fused_sgd import FusedSGD, FusedSGDState  # noqa: F401
 from .fused_novograd import FusedNovoGrad, FusedNovoGradState  # noqa: F401
 from .fused_adagrad import FusedAdagrad, FusedAdagradState  # noqa: F401
 from ._common import FusedOptimizer  # noqa: F401
+from ._packed import PackedState  # noqa: F401

@@ -1,0 +1,112 @@
+"""Packed optimizer state: flat buffers + static PackSpec bookkeeping.
+
+The packed counterpart of the pytree protocol in ``_common.py``: when an
+optimizer is constructed with ``packed=True``, ``init`` returns a
+:class:`PackedState` whose moments/masters are contiguous 1-D fp32
+buffers (``DistributedFusedAdam``'s flat-bucket design, single-device)
+and ``step`` runs the fused chunked kernels from
+``apex_tpu.ops.packed_optimizer`` instead of a per-leaf tree_map. The
+public ``init``/``step``/``as_gradient_transformation`` signatures are
+unchanged; only the state type differs.
+
+Donation: the flat buffers are aliased in place by the kernels
+(``input_output_aliases``) — donate the state into your jitted step
+(``jax.jit(step, donate_argnums=...)``) or XLA falls back to copying the
+full optimizer state each step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..multi_tensor_apply.packing import DEFAULT_CHUNK, PackSpec
+
+Pytree = Any
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedState:
+    """Flat-buffer optimizer state.
+
+    Children (traced): ``step`` i32 scalar, ``exp_avg`` / ``exp_avg_sq``
+    fp32 flat buffers (``exp_avg`` doubles as the SGD momentum buffer;
+    ``exp_avg_sq`` is per-LEAF, not per-element, for NovoGrad),
+    ``master_params`` fp32 flat buffer or None.
+
+    Aux (static, hashable): the :class:`PackSpec` — treedef, shapes and
+    chunk-aligned offsets, the host-side bucket bookkeeping.
+    """
+
+    def __init__(self, step, exp_avg, exp_avg_sq, master_params,
+                 spec: PackSpec):
+        self.step = step
+        self.exp_avg = exp_avg
+        self.exp_avg_sq = exp_avg_sq
+        self.master_params = master_params
+        self.spec = spec
+
+    # SGD spelling
+    @property
+    def momentum_buffer(self):
+        return self.exp_avg
+
+    def tree_flatten(self):
+        return ((self.step, self.exp_avg, self.exp_avg_sq,
+                 self.master_params), self.spec)
+
+    @classmethod
+    def tree_unflatten(cls, spec, children):
+        return cls(*children, spec)
+
+    def __repr__(self):
+        return f"PackedState(step={self.step}, spec={self.spec})"
+
+
+def packed_init(
+    params: Pytree,
+    *,
+    chunk_size: Optional[int] = None,
+    with_exp_avg_sq: bool = True,
+    per_leaf_exp_avg_sq: bool = False,
+    master_weights: bool = False,
+) -> PackedState:
+    """Build the flat-buffer state for ``params``."""
+    spec = PackSpec(params, chunk_size=chunk_size or DEFAULT_CHUNK)
+    if per_leaf_exp_avg_sq:
+        exp_avg_sq = jnp.zeros((spec.n_leaves,), jnp.float32)
+    elif with_exp_avg_sq:
+        exp_avg_sq = spec.zeros(jnp.float32)
+    else:
+        exp_avg_sq = None
+    # force a copy: for a single fp32 leaf of exact chunk-multiple size,
+    # pack() is the identity and the master would ALIAS the live param
+    # buffer — donating params+state would then donate one buffer twice
+    # (the same hazard _common.tree_f32 guards against)
+    master = (jnp.array(spec.pack(params, jnp.float32), copy=True)
+              if master_weights else None)
+    return PackedState(
+        step=jnp.int32(0),
+        exp_avg=spec.zeros(jnp.float32),
+        exp_avg_sq=exp_avg_sq,
+        master_params=master,
+        spec=spec,
+    )
+
+
+def tree_common_dtype(tree: Pytree, fallback=jnp.float32):
+    """The single dtype shared by all leaves, else ``fallback`` — the flat
+    buffer must be homogeneous; unpack casts leaves back individually."""
+    dtypes = {jnp.dtype(l.dtype) for l in jax.tree_util.tree_leaves(tree)}
+    return dtypes.pop() if len(dtypes) == 1 else jnp.dtype(fallback)
+
+
+def packed_src(state: PackedState, params: Pytree,
+               master_weights: bool) -> jax.Array:
+    """The fp32 update source: resident masters, or params packed on the
+    fly (the no-master mode pays one packing sweep, exactly like the
+    pytree path's per-leaf upcasts)."""
+    if master_weights:
+        return state.master_params
+    return state.spec.pack(params, jnp.float32)

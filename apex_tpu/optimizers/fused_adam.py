@@ -17,6 +17,12 @@ Reference behaviour: ``apex/optimizers/fused_adam.py:4-488`` over
   count are computed transiently for the param update but **not** persisted.
 - ``grad_scale``/``found_inf`` hooks matching the capturable-master kernel's
   ``inv_scale``/``noop_flag`` arguments.
+- ``packed=True``: state becomes flat fp32 buffers
+  (:class:`~apex_tpu.optimizers._packed.PackedState`) and the whole step —
+  unscale + Adam + master->param recast — is ONE chunked Pallas sweep
+  (``apex_tpu.ops.packed_optimizer.packed_adam_apply``), the actual
+  ``multi_tensor_apply`` contract instead of trusting XLA to fuse the
+  per-leaf chain. Donate params+state into your jitted step.
 
 Moments are fp32 regardless of param/grad dtype (kernel ``MATH_T float``).
 """
@@ -27,6 +33,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.packed_optimizer import packed_adam_apply
 from ._common import (
     FusedOptimizer,
     Pytree,
@@ -36,6 +43,7 @@ from ._common import (
     tree_f32,
     tree_zeros_like,
 )
+from ._packed import PackedState, packed_init, packed_src, tree_common_dtype
 
 
 class FusedAdamState(NamedTuple):
@@ -58,6 +66,9 @@ class FusedAdam(FusedOptimizer):
         set_grad_none: bool = True,  # accepted for parity; meaningless functionally
         capturable: bool = True,  # always-on under jit; accepted for parity
         master_weights: bool = False,
+        packed: bool = False,
+        packed_chunk_size: Optional[int] = None,
+        packed_interpret: bool = False,
     ):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
@@ -68,8 +79,17 @@ class FusedAdam(FusedOptimizer):
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
         self.master_weights = master_weights
+        self.packed = packed
+        self.packed_chunk_size = packed_chunk_size
+        self.packed_interpret = packed_interpret
 
-    def init(self, params: Pytree) -> FusedAdamState:
+    def init(self, params: Pytree):
+        if self.packed:
+            return packed_init(
+                params,
+                chunk_size=self.packed_chunk_size,
+                master_weights=self.master_weights,
+            )
         return FusedAdamState(
             step=jnp.int32(0),
             exp_avg=tree_zeros_like(params, jnp.float32),
@@ -122,6 +142,58 @@ class FusedAdam(FusedOptimizer):
         )
         return new_params, new_state
 
+    def _bias_corrections(self, step):
+        beta1, beta2 = self.betas
+        if not self.bias_correction:
+            return jnp.float32(1.0), jnp.float32(1.0)
+        t = step.astype(jnp.float32)
+        return 1.0 - beta1 ** t, 1.0 - beta2 ** t
+
+    def _packed_stepped(self, grads, state: PackedState, params, lr, wd,
+                        inv_scale, write_mv=True):
+        """One fused chunked sweep over the flat buffers (the
+        ``multi_tensor_adam`` launch). ``write_mv=False`` is the fork's
+        transient-m/v mode: only params are written."""
+        spec = state.spec
+        beta1, beta2 = self.betas
+        new_step = state.step + 1
+        bc1, bc2 = self._bias_corrections(new_step)
+        flat_g = spec.pack(grads, tree_common_dtype(grads))
+        p_out, ms, vs, master = packed_adam_apply(
+            flat_g,
+            state.exp_avg,
+            state.exp_avg_sq,
+            packed_src(state, params, self.master_weights),
+            param_dtype=spec.common_dtype(),
+            lr=jnp.asarray(lr, jnp.float32),
+            bc1=bc1,
+            bc2=bc2,
+            inv_scale=inv_scale,
+            beta1=beta1,
+            beta2=beta2,
+            eps=self.eps,
+            wd=wd,
+            adam_w_mode=self.adam_w_mode,
+            write_mv=write_mv,
+            # no_update_mv (write_mv=False) must not advance masters
+            # either — and the discarded output would cost a full dead
+            # fp32 write plus a defensive copy of the aliased buffer
+            write_master=write_mv and self.master_weights,
+            chunk_size=spec.chunk_size,
+            interpret=self.packed_interpret,
+        )
+        new_params = spec.unpack(p_out)
+        if not write_mv:
+            return new_params, state
+        new_state = PackedState(
+            step=new_step,
+            exp_avg=ms,
+            exp_avg_sq=vs,
+            master_params=master if self.master_weights else None,
+            spec=spec,
+        )
+        return new_params, new_state
+
     # -- public API --------------------------------------------------------
     def step(
         self,
@@ -136,9 +208,10 @@ class FusedAdam(FusedOptimizer):
         lr = self.lr if lr is None else lr
         wd = self.weight_decay if weight_decay is None else weight_decay
         inv_scale = resolve_scale(grad_scale)
+        stepped = (self._packed_stepped if self.packed else self._stepped)
         return skip_on_overflow(
             found_inf,
-            lambda: self._stepped(grads, state, params, lr, wd, inv_scale),
+            lambda: stepped(grads, state, params, lr, wd, inv_scale),
             (params, state),
         )
 
@@ -163,6 +236,10 @@ class FusedAdam(FusedOptimizer):
         inv_scale = resolve_scale(grad_scale)
 
         def do():
+            if self.packed:
+                # kernel-level transient m/v: only params are written
+                return self._packed_stepped(
+                    grads, state, params, lr, wd, inv_scale, write_mv=False)
             new_params, _ = self._stepped(grads, state, params, lr, wd, inv_scale)
             return new_params, state
 

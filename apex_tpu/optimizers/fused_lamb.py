@@ -24,6 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.multi_tensor import multi_tensor_l2norm
+from ..ops.packed_optimizer import (
+    packed_lamb_stage1,
+    packed_row_reduce,
+    packed_scale_update,
+)
 from ._common import (
     FusedOptimizer,
     Pytree,
@@ -33,6 +38,7 @@ from ._common import (
     tree_f32,
     tree_zeros_like,
 )
+from ._packed import PackedState, packed_init, packed_src, tree_common_dtype
 
 
 class FusedLAMBState(NamedTuple):
@@ -57,6 +63,9 @@ class FusedLAMB(FusedOptimizer):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         master_weights: bool = False,
+        packed: bool = False,
+        packed_chunk_size: Optional[int] = None,
+        packed_interpret: bool = False,
     ):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
@@ -70,8 +79,17 @@ class FusedLAMB(FusedOptimizer):
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
         self.master_weights = master_weights
+        self.packed = packed
+        self.packed_chunk_size = packed_chunk_size
+        self.packed_interpret = packed_interpret
 
-    def init(self, params: Pytree) -> FusedLAMBState:
+    def init(self, params: Pytree):
+        if self.packed:
+            return packed_init(
+                params,
+                chunk_size=self.packed_chunk_size,
+                master_weights=self.master_weights,
+            )
         return FusedLAMBState(
             step=jnp.int32(0),
             exp_avg=tree_zeros_like(params, jnp.float32),
@@ -130,6 +148,69 @@ class FusedLAMB(FusedOptimizer):
             master_params=p32s if self.master_weights else None,
         )
 
+    def _packed_stepped(self, grads, state: PackedState, params, lr,
+                        inv_scale):
+        """Flat-buffer LAMB in three chunked sweeps, mirroring the CUDA
+        structure (``multi_tensor_l2norm`` -> ``lamb`` stage1 -> stage2):
+        grad-norm partials, moments + unratioed update + per-row norm
+        partials, then the trust-ratio apply + recast. Per-tensor trust
+        ratios come from ``segment_sum`` over ``PackSpec.row_leaf_ids()``
+        — rows are leaf-aligned, so the partials never straddle tensors."""
+        spec = state.spec
+        beta1, beta2 = self.betas
+        beta3 = 1.0 - beta1 if self.grad_averaging else 1.0
+        new_step = state.step + 1
+        t = new_step.astype(jnp.float32)
+        bc1 = 1.0 - beta1 ** t if self.bias_correction else jnp.float32(1.0)
+        bc2 = 1.0 - beta2 ** t if self.bias_correction else jnp.float32(1.0)
+        wd = self.weight_decay
+        kw = dict(chunk_size=spec.chunk_size, interpret=self.packed_interpret)
+
+        flat_g = spec.pack(grads, tree_common_dtype(grads))
+        # phase 1: global unscaled grad norm (fused_lamb.py:124-137)
+        row_g_sq = packed_row_reduce(
+            flat_g, op="sqsum", inv_scale=inv_scale, **kw)
+        global_norm = jnp.sqrt(jnp.sum(row_g_sq))
+        if self.max_grad_norm > 0:
+            clip = jnp.maximum(global_norm / self.max_grad_norm, 1.0)
+        else:
+            clip = jnp.float32(1.0)
+
+        src = packed_src(state, params, self.master_weights)
+        update, ms, vs, row_u_sq, row_p_sq = packed_lamb_stage1(
+            flat_g, state.exp_avg, state.exp_avg_sq, src,
+            clip=clip, bc1=bc1, bc2=bc2, inv_scale=inv_scale,
+            beta1=beta1, beta2=beta2, beta3=beta3, eps=self.eps,
+            wd=wd, adam_w_mode=self.adam_w_mode, **kw)
+
+        if wd != 0.0 or self.use_nvlamb:
+            seg = jnp.asarray(spec.row_leaf_ids())
+            n_seg = spec.n_leaves + 1  # last segment = padding rows
+            u_norm = jnp.sqrt(jax.ops.segment_sum(
+                row_u_sq, seg, num_segments=n_seg))
+            w_norm = jnp.sqrt(jax.ops.segment_sum(
+                row_p_sq, seg, num_segments=n_seg))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                w_norm / jnp.maximum(u_norm, jnp.float32(1e-38)), 1.0)
+            ratio = ratio.at[-1].set(1.0)  # padding segment
+            row_coef = ratio[seg]
+        else:
+            row_coef = jnp.ones((spec.n_rows,), jnp.float32)
+
+        p_out, master = packed_scale_update(
+            update, src, row_coef,
+            param_dtype=spec.common_dtype(),
+            lr=jnp.asarray(lr, jnp.float32),
+            write_master=self.master_weights, **kw)
+        return spec.unpack(p_out), PackedState(
+            step=new_step,
+            exp_avg=ms,
+            exp_avg_sq=vs,
+            master_params=master if self.master_weights else None,
+            spec=spec,
+        )
+
     def step(
         self,
         grads: Pytree,
@@ -141,9 +222,10 @@ class FusedLAMB(FusedOptimizer):
     ) -> Tuple[Pytree, FusedLAMBState]:
         lr = self.lr if lr is None else lr
         inv_scale = resolve_scale(grad_scale)
+        stepped = (self._packed_stepped if self.packed else self._stepped)
         return skip_on_overflow(
             found_inf,
-            lambda: self._stepped(grads, state, params, lr, inv_scale),
+            lambda: stepped(grads, state, params, lr, inv_scale),
             (params, state),
         )
 

@@ -15,6 +15,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.packed_optimizer import packed_sgd_apply
 from ._common import (
     FusedOptimizer,
     Pytree,
@@ -24,6 +25,7 @@ from ._common import (
     tree_f32,
     tree_zeros_like,
 )
+from ._packed import PackedState, packed_init, packed_src, tree_common_dtype
 
 
 class FusedSGDState(NamedTuple):
@@ -44,6 +46,9 @@ class FusedSGD(FusedOptimizer):
         materialize_master_grads: bool = True,  # parity; grads are functional here
         set_grad_none: bool = False,  # parity
         master_weights: bool = False,
+        packed: bool = False,
+        packed_chunk_size: Optional[int] = None,
+        packed_interpret: bool = False,
     ):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero dampening")
@@ -54,8 +59,19 @@ class FusedSGD(FusedOptimizer):
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
         self.master_weights = master_weights
+        self.packed = packed
+        self.packed_chunk_size = packed_chunk_size
+        self.packed_interpret = packed_interpret
 
-    def init(self, params: Pytree) -> FusedSGDState:
+    def init(self, params: Pytree):
+        if self.packed:
+            # exp_avg doubles as the momentum buffer; no second moment
+            return packed_init(
+                params,
+                chunk_size=self.packed_chunk_size,
+                with_exp_avg_sq=False,
+                master_weights=self.master_weights,
+            )
         return FusedSGDState(
             step=jnp.int32(0),
             momentum_buffer=tree_zeros_like(params, jnp.float32),
@@ -95,6 +111,36 @@ class FusedSGD(FusedOptimizer):
             master_params=p32s if self.master_weights else None,
         )
 
+    def _packed_stepped(self, grads, state: PackedState, params, lr,
+                        inv_scale):
+        """One fused chunked sweep (``multi_tensor_sgd_kernel.cu``)."""
+        spec = state.spec
+        flat_g = spec.pack(grads, tree_common_dtype(grads))
+        p_out, bufs, master = packed_sgd_apply(
+            flat_g,
+            state.exp_avg,
+            packed_src(state, params, self.master_weights),
+            param_dtype=spec.common_dtype(),
+            lr=jnp.asarray(lr, jnp.float32),
+            first_run=state.step == 0,
+            inv_scale=inv_scale,
+            momentum=self.momentum,
+            dampening=self.dampening,
+            nesterov=self.nesterov,
+            wd=self.weight_decay,
+            wd_after_momentum=self.wd_after_momentum,
+            write_master=self.master_weights,
+            chunk_size=spec.chunk_size,
+            interpret=self.packed_interpret,
+        )
+        return spec.unpack(p_out), PackedState(
+            step=state.step + 1,
+            exp_avg=bufs,
+            exp_avg_sq=None,
+            master_params=master if self.master_weights else None,
+            spec=spec,
+        )
+
     def step(
         self,
         grads: Pytree,
@@ -106,8 +152,9 @@ class FusedSGD(FusedOptimizer):
     ) -> Tuple[Pytree, FusedSGDState]:
         lr = self.lr if lr is None else lr
         inv_scale = resolve_scale(grad_scale)
+        stepped = (self._packed_stepped if self.packed else self._stepped)
         return skip_on_overflow(
             found_inf,
-            lambda: self._stepped(grads, state, params, lr, inv_scale),
+            lambda: stepped(grads, state, params, lr, inv_scale),
             (params, state),
         )

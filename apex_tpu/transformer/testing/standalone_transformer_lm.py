@@ -95,6 +95,11 @@ class GPTConfig:
     # [b*s, vocab] saved memory in compute_dtype; saves one GEMM + one
     # reduce pass per chunk (~5 ms/step on the 345M v5e bench).
     ce_save_logits: bool = False
+    # Unroll the chunked-CE loop: with ce_save_logits the [b*s, vocab]
+    # buffer is materialised either way, so unrolling trades the scan's
+    # dynamic-update-slice stacking (the bench's bitcast_DUS data-movement
+    # bucket, docs/dus_bucket.md) for concatenates at no memory cost.
+    ce_unroll: bool = False
     # fp8 (e4m3 fwd + e5m2 grads, TE-style delayed scaling) on the four
     # projection GEMMs per layer (qkv / proj / fc1 / fc2). Thread
     # ``init_gpt_fp8_states(cfg)`` through ``gpt_loss(...,
@@ -980,6 +985,7 @@ def gpt_loss(
             save_logits_dtype=(
                 cfg.compute_dtype if cfg.ce_save_logits else None
             ),
+            unroll=cfg.ce_unroll,
         ).reshape(s, b)
         losses = jnp.transpose(losses, (1, 0))  # [b, s]
     if cfg.context_parallel_axis is not None:

@@ -23,7 +23,8 @@ Measurement discipline (round-2/3 fixes):
   kernel disabled (pure-XLA attention) — the kernels-pay-for-themselves
   delta the judge asked for. Skipped when BENCH_FAST=1.
 
-Configs: GPT-2 345M (24 x 1024 x 16 heads, seq 1024, bf16, FusedAdam,
+Configs: GPT-2 345M (24 x 1024 x 16 heads, seq 1024, bf16, packed
+flat-buffer FusedAdam — BENCH_GPT_PACKED=0 for the pytree A/B,
 selective recompute, flash attention, chunk-fused LM-head CE),
 BERT-large (24 x 1024 x 16, seq 512, bf16, FusedLAMB, padding attention)
 and ResNet-50 (amp O2 + FusedSGD, batch 64).
@@ -151,7 +152,8 @@ def _timed_steps(step_fn, state, iters):
 
 
 def bench_gpt(iters, batch, seq, remat, master_weights=True,
-              ce_save_logits=None, capture_state=False, fp8=False):
+              ce_save_logits=None, capture_state=False, fp8=False,
+              packed=None):
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import (
         GPTConfig, gpt_loss, init_gpt_fp8_carriers, init_gpt_fp8_states,
@@ -171,6 +173,11 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
         # update-slice machinery (~40 ms/step here) for longer compiles
         layer_unroll=-1,
         ce_save_logits=ce_save_logits,
+        # A/B knob for the bitcast_dynamic-update-slice bucket (the CE
+        # chunk scan's ys stacking, docs/dus_bucket.md): free when the
+        # logits are saved anyway
+        ce_unroll=bool(ce_save_logits)
+        and os.environ.get("BENCH_CE_UNROLL", "0") == "1",
         fp8=fp8,
     )
     params = init_gpt_params(cfg, jax.random.PRNGKey(0))
@@ -180,7 +187,13 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
         # cast pass
         params = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.bfloat16), params)
-    opt = FusedAdam(lr=1e-4, master_weights=master_weights)
+    if packed is None:
+        # headline default: the packed flat-buffer optimizer — ONE chunked
+        # Pallas sweep for unscale+Adam+recast instead of XLA's per-leaf
+        # elementwise fusions (the round-5 42.7% fusion bucket).
+        # BENCH_GPT_PACKED=0 restores the pytree path for A/B.
+        packed = os.environ.get("BENCH_GPT_PACKED", "1") != "0"
+    opt = FusedAdam(lr=1e-4, master_weights=master_weights, packed=packed)
     opt_state = opt.init(params)
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
@@ -422,6 +435,57 @@ def measure_hbm_bandwidth(size_mb=1024, inner=50):
     return bw
 
 
+def bench_packed_optimizer(iters, hbm_gbps=819.0, hbm_recognised=False):
+    """Packed-optimizer microbench: a GPT-345M-scale FusedAdam sweep
+    (bf16 params+grads, fp32 m/v/masters in flat buffers) timed as
+    achieved GB/s against the HBM roof, plus the speedup over the pytree
+    path on identical state. The byte count is the MINIMUM algorithmic
+    traffic (read g+m+v+master, write m+v+master+params = 28 B/param at
+    bf16 params) — packing/unpacking overhead is inside the measured
+    time but not credited, so gbps_achieved is conservative."""
+    import time
+
+    from apex_tpu.optimizers import FusedAdam
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_params = int(os.environ.get(
+        "BENCH_PACKED_PARAMS", str(344 * 2**20 if on_tpu else 2**21)))
+    leaf = 2048 * 2048 if on_tpu else 2**18
+    n_leaves = max(1, n_params // leaf)
+    n_params = n_leaves * leaf
+    keys = [f"w{i}" for i in range(n_leaves)]
+
+    def measure(packed):
+        params = {k: jnp.zeros((leaf,), jnp.bfloat16) for k in keys}
+        grads = {k: jnp.full((leaf,), 1e-3, jnp.bfloat16) for k in keys}
+        opt = FusedAdam(lr=1e-3, master_weights=True, packed=packed)
+        state = opt.init(params)
+        step = jax.jit(lambda g, s, p: opt.step(g, s, p),
+                       donate_argnums=(1, 2))
+        params, state = step(grads, state, params)  # compile + warm
+        float(jnp.asarray(params[keys[0]][0], jnp.float32))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, state = step(grads, state, params)
+        float(jnp.asarray(params[keys[0]][0], jnp.float32))
+        return (time.perf_counter() - t0) / iters
+
+    t_packed = _retry_transient(lambda: measure(True), tag="packed opt")
+    t_pytree = _retry_transient(lambda: measure(False), tag="pytree opt")
+    bytes_min = 28 * n_params
+    return {
+        "n_params": n_params,
+        "step_ms": round(t_packed * 1000.0, 3),
+        "pytree_step_ms": round(t_pytree * 1000.0, 3),
+        "vs_pytree": round(t_pytree / t_packed, 4),  # >1: packed faster
+        "gbps_achieved": round(bytes_min / t_packed / 1e9, 1),
+        "hbm_gbps_nameplate": hbm_gbps if hbm_recognised else None,
+        "pct_of_nameplate": (
+            round(bytes_min / t_packed / 1e9 / hbm_gbps, 4)
+            if hbm_recognised else None),
+    }
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -643,6 +707,18 @@ def main() -> None:
             for p in points
         ]
 
+    packed_opt = None
+    if not fast:
+        try:
+            packed_opt = bench_packed_optimizer(
+                max(iters, 10), hbm_gbps=hbm_gbps,
+                hbm_recognised=hbm_recognised)
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"packed optimizer bench failed: {type(e).__name__}: {e}",
+                  file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -707,6 +783,7 @@ def main() -> None:
                              if vs_xla_attention else None),
         "bert_large_lamb": bert,
         "resnet50_o2": resnet,
+        "packed_optimizer": packed_opt,
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,
