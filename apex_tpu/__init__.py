@@ -19,6 +19,9 @@ import surface):
                                  attention, xentropy, group norm, ASP, ...)
 - ``apex_tpu.fp16_utils``        legacy manual mixed-precision utilities
 - ``apex_tpu.mlp`` / ``apex_tpu.fused_dense``  fused MLP / dense modules
+- ``apex_tpu.telemetry``         training-run observability (in-jit metrics,
+                                 JSONL/ring sinks, trace sessions, pipeline
+                                 bubble accounting)
 """
 import logging
 import sys
@@ -85,6 +88,7 @@ _LAZY_SUBMODULES = (
     "ops",
     "RNN",
     "checkpoint",
+    "telemetry",
 )
 
 

@@ -110,12 +110,30 @@ class LossScaler:
         out, found = multi_tensor_axpby(inv, 1.0, new_scaled_grads, stashed_grads)
         return out, state._replace(found_inf=state.found_inf | found)
 
-    def update_scale(self, state: LossScaleState) -> LossScaleState:
+    def update_scale(self, state: LossScaleState, metrics=None):
         """End-of-step scale adjustment (``apex/amp/scaler.py:197-216``).
 
         Consumes ``found_inf`` and resets it for the next step. Static mode
         only clears the flag.
+
+        With ``metrics=`` (an ``apex_tpu.telemetry.MetricsState``) the
+        scaler also folds this update into the cumulative telemetry
+        counters — ``overflow_skips`` increments when the consumed
+        ``found_inf`` skipped the step, ``scale_growths`` when the scale
+        grew — and returns ``(new_state, new_metrics)`` instead of just
+        the state. Pure in-jit arithmetic: no extra host syncs.
         """
+        new_state = self._update_scale(state)
+        if metrics is None:
+            return new_state
+        from ..telemetry.metrics import observe_scale_update
+
+        metrics = observe_scale_update(
+            metrics, state.found_inf, state.loss_scale,
+            new_state.loss_scale)
+        return new_state, metrics
+
+    def _update_scale(self, state: LossScaleState) -> LossScaleState:
         if not self.dynamic:
             return state._replace(found_inf=jnp.asarray(False))
         scale, unskipped, hyst = update_scale_hysteresis(

@@ -143,6 +143,7 @@ def _pad_to_rows(flat: jax.Array,
 # ---------------------------------------------------------------------------
 # fused Adam (the headline one-sweep step)
 # ---------------------------------------------------------------------------
+@jax.named_scope("apex_tpu.packed_adam")
 def packed_adam_apply(
     flat_g: jax.Array,
     flat_m: jax.Array,
@@ -282,6 +283,7 @@ def packed_adam_apply(
 # ---------------------------------------------------------------------------
 # fused SGD
 # ---------------------------------------------------------------------------
+@jax.named_scope("apex_tpu.packed_sgd")
 def packed_sgd_apply(
     flat_g: jax.Array,
     flat_buf: jax.Array,  # fp32 momentum buffer
@@ -384,6 +386,7 @@ def packed_sgd_apply(
 # ---------------------------------------------------------------------------
 # LAMB stages
 # ---------------------------------------------------------------------------
+@jax.named_scope("apex_tpu.packed_lamb_stage1")
 def packed_lamb_stage1(
     flat_g: jax.Array,
     flat_m: jax.Array,
@@ -467,6 +470,7 @@ def packed_lamb_stage1(
             ru.reshape(-1), rp.reshape(-1))
 
 
+@jax.named_scope("apex_tpu.packed_scale_update")
 def packed_scale_update(
     flat_u: jax.Array,
     flat_src: jax.Array,
@@ -524,6 +528,7 @@ def packed_scale_update(
 # ---------------------------------------------------------------------------
 # NovoGrad elementwise stage
 # ---------------------------------------------------------------------------
+@jax.named_scope("apex_tpu.packed_novograd")
 def packed_novograd_apply(
     flat_g: jax.Array,
     flat_m: jax.Array,
@@ -597,6 +602,7 @@ def packed_novograd_apply(
 # ---------------------------------------------------------------------------
 # reductions + amp_C utility ops over flat buffers
 # ---------------------------------------------------------------------------
+@jax.named_scope("apex_tpu.packed_row_reduce")
 def packed_row_reduce(
     flat: jax.Array,
     *,
@@ -639,6 +645,7 @@ def packed_row_reduce(
     return out.reshape(-1)
 
 
+@jax.named_scope("apex_tpu.multi_tensor_l2norm_flat")
 def multi_tensor_l2norm_flat(
     flat: jax.Array,
     *,
@@ -662,6 +669,7 @@ def multi_tensor_l2norm_flat(
 multi_tensor_l2norm_flat.accepts_chunk_size = True
 
 
+@jax.named_scope("apex_tpu.multi_tensor_scale_flat")
 def multi_tensor_scale_flat(
     flat: jax.Array,
     scale,
@@ -706,6 +714,7 @@ def multi_tensor_scale_flat(
 multi_tensor_scale_flat.accepts_chunk_size = True
 
 
+@jax.named_scope("apex_tpu.multi_tensor_axpby_flat")
 def multi_tensor_axpby_flat(
     a,
     b,

@@ -52,6 +52,32 @@ class PackedState:
     def momentum_buffer(self):
         return self.exp_avg
 
+    def sweep_bytes(self) -> int:
+        """Minimum algorithmic HBM traffic of one packed step, in bytes:
+        read grads + read/write each present fp32 state buffer + write
+        params. The telemetry denominator for achieved GB/s per drain
+        (``telemetry.drain(..., bytes_per_step=state.sweep_bytes())``);
+        packing overhead is not credited, so derived GB/s is conservative.
+        For bf16 params with masters this is the documented 28 B/param.
+        """
+        import numpy as np
+
+        spec = self.spec
+        # the kernels sweep full chunk-padded flat buffers (spec.total
+        # elements), so traffic is counted at that length throughout
+        param_itemsize = np.dtype(spec.common_dtype()).itemsize
+        # grads read + params write, at the packed param dtype
+        total = 2 * param_itemsize * spec.total
+        total += 2 * 4 * spec.total  # exp_avg (momentum) read + write
+        if self.exp_avg_sq is not None:
+            # per-LEAF (NovoGrad) second moments are scalars — negligible
+            n_sq = (self.exp_avg_sq.shape[0]
+                    if self.exp_avg_sq.ndim else 1)
+            total += 2 * 4 * int(n_sq)
+        if self.master_params is not None:
+            total += 2 * 4 * spec.total
+        return int(total)
+
     def tree_flatten(self):
         return ((self.step, self.exp_avg, self.exp_avg_sq,
                  self.master_params), self.spec)

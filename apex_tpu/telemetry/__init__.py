@@ -1,0 +1,68 @@
+"""apex_tpu.telemetry: training-run observability.
+
+Four parts, designed so instrumentation costs nothing on the hot path:
+
+- :mod:`~apex_tpu.telemetry.metrics` — a jit-resident
+  :class:`MetricsState` pytree accumulated on device inside the step
+  function and drained every N steps through an async
+  ``jax.debug.callback`` (zero extra host syncs);
+- :mod:`~apex_tpu.telemetry.recorder` — host sinks (JSONL writer, ring
+  buffer, fan-out) with rank-0 gating and the ``add_scalar`` writer
+  protocol ``Timers.write`` expects;
+- :mod:`~apex_tpu.telemetry.tracing` — ``trace_session`` /
+  ``profile_step`` around ``jax.profiler`` with a categorized per-op
+  device-time table (xplane) and a ``cost_analysis()`` flops/bytes
+  fallback off-TPU;
+- :mod:`~apex_tpu.telemetry.pipeline` — pipeline bubble accounting:
+  analytic warmup/steady/cooldown timelines per rank and a measured
+  :class:`TickTimeline` fed by the schedules' ``tick_hook``.
+
+See ``docs/observability.md`` for the end-to-end story.
+"""
+from .metrics import (  # noqa: F401
+    MetricsState,
+    accumulate,
+    drain,
+    init_metrics,
+    observe_scale_update,
+    summarize,
+)
+from .pipeline import (  # noqa: F401
+    TickTimeline,
+    analytic_bubble_fraction,
+    bubble_report,
+    classify_phase,
+    schedule_ticks,
+    tick_phases,
+)
+from .recorder import (  # noqa: F401
+    JsonlRecorder,
+    MultiRecorder,
+    NullRecorder,
+    RingBufferRecorder,
+    is_logging_process,
+    read_jsonl,
+)
+from .tracing import (  # noqa: F401
+    TraceSession,
+    aggregate_op_times,
+    breakdown_table,
+    categorize_op,
+    cost_analysis_breakdown,
+    parse_xspace_op_times,
+    profile_step,
+    short_op_name,
+    trace_session,
+)
+
+__all__ = [
+    "MetricsState", "accumulate", "drain", "init_metrics",
+    "observe_scale_update", "summarize",
+    "TickTimeline", "analytic_bubble_fraction", "bubble_report",
+    "classify_phase", "schedule_ticks", "tick_phases",
+    "JsonlRecorder", "MultiRecorder", "NullRecorder",
+    "RingBufferRecorder", "is_logging_process", "read_jsonl",
+    "TraceSession", "aggregate_op_times", "breakdown_table",
+    "categorize_op", "cost_analysis_breakdown", "parse_xspace_op_times",
+    "profile_step", "short_op_name", "trace_session",
+]

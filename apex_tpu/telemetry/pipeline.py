@@ -1,0 +1,250 @@
+"""Pipeline bubble accounting: analytic timelines + measured tick hooks.
+
+The reference's pipeline efficiency story is the textbook bubble fraction
+``(p-1)/(m+p-1)`` (p stages, m microbatches); its schedules are
+host-driven loops, so per-microbatch timing falls out of the driver. Our
+schedules are single-jit ``lax.scan`` SPMD programs — every rank executes
+every tick, and "bubble" ticks are *masked garbage compute*, not idle
+time. This module accounts for both views:
+
+- **Analytic**: :func:`analytic_bubble_fraction` and :func:`tick_phases`
+  derive, from the schedule shape alone, each rank's per-tick phase
+  (warmup / steady / cooldown / idle) and the wasted-work fraction —
+  exact for the scan schedules because every tick costs the same.
+- **Measured**: :class:`TickTimeline` collects per-(tick, rank) host
+  timestamps from the schedules' ``tick_hook`` (an async
+  ``jax.debug.callback`` per scan tick — see
+  ``schedules/fwd_bwd_1f1b.py`` etc.) and reports measured per-phase
+  wall time plus a measured bubble fraction to compare against the
+  analytic one.
+
+Hook caveat (jax partial-eval): ``jax.debug.callback`` inside a scan
+that is differentiated THROUGH is dropped by linearization, so hooks
+fire for ``forward_only`` runs of the autodiff pipeline schedules, and
+always for the schedules whose scan is never itself differentiated: the
+TRUE 1F1B schedule (its backward runs inside the scan body — exactly
+the schedule where warmup/steady/cooldown is meaningful) and
+no-pipelining (grad runs inside the body). Timestamps are host arrival
+times of async callbacks:
+faithful in steady state, approximate at the boundaries; use
+:func:`apex_tpu.telemetry.trace_session` for exact device times.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+SCHEDULES = ("scan", "1f1b")
+
+
+def _check(pp: int, n_micro: int, num_chunks: int, schedule: str):
+    if schedule not in SCHEDULES:
+        raise ValueError(f"schedule must be one of {SCHEDULES}, got "
+                         f"{schedule!r}")
+    if pp < 1 or n_micro < 1 or num_chunks < 1:
+        raise ValueError("pp, n_micro, num_chunks must be >= 1")
+
+
+def schedule_ticks(pp: int, n_micro: int, num_chunks: int = 1,
+                   schedule: str = "scan") -> int:
+    """Total scan ticks the schedule runs (every rank runs all of them)."""
+    _check(pp, n_micro, num_chunks, schedule)
+    nv = n_micro * num_chunks
+    if schedule == "scan":
+        return nv + pp - 1
+    d = (num_chunks - 1) * pp + (pp - 1)
+    return nv + d + (pp - 1)
+
+
+def analytic_bubble_fraction(pp: int, n_micro: int, num_chunks: int = 1,
+                             schedule: str = "scan") -> float:
+    """Fraction of schedule work that is pipeline bubble.
+
+    - ``scan`` (the autodiff forward schedules, ``pipeline_rounds``):
+      ``(pp-1) / (n·vpp + pp-1)`` — the textbook ``(p-1)/(m+p-1)`` at
+      ``vpp=1``; interleaving divides the numerator's *relative* weight
+      by vpp exactly as the reference's ``(p-1)/(m·vpp)`` class.
+    - ``1f1b`` (the in-schedule-backward module): each tick is an (F, B)
+      double-tick; warmup ticks run F only and cooldown B only, so the
+      wasted half-ticks sum to ``(D + pp - 1) / T`` with
+      ``D = (vpp-1)·pp + (pp-1)`` and ``T = n·vpp + D + pp - 1`` —
+      identical on every rank.
+    """
+    nv = n_micro * num_chunks
+    t = schedule_ticks(pp, n_micro, num_chunks, schedule)
+    if schedule == "scan":
+        return (pp - 1) / t
+    return (t - nv) / t
+
+
+def tick_phases(pp: int, n_micro: int, num_chunks: int = 1,
+                schedule: str = "scan") -> List[List[str]]:
+    """Per-rank, per-tick phase labels (``len == pp`` lists of length
+    :func:`schedule_ticks`).
+
+    Phases: ``warmup`` (forward work only), ``steady`` (forward+backward
+    for 1f1b; active forward for scan), ``cooldown`` (backward only),
+    ``idle`` (masked garbage compute — the literal bubble).
+    """
+    _check(pp, n_micro, num_chunks, schedule)
+    nv = n_micro * num_chunks
+    total = schedule_ticks(pp, n_micro, num_chunks, schedule)
+    d = (num_chunks - 1) * pp + (pp - 1)
+    out = []
+    for r in range(pp):
+        row = []
+        for t in range(total):
+            f_active = 0 <= t - r < nv
+            if schedule == "scan":
+                # forward-only ticks: active is steady work, the rest is
+                # the (masked garbage) bubble
+                row.append("steady" if f_active else "idle")
+                continue
+            b_active = 0 <= t - d - (pp - 1 - r) < nv
+            row.append(classify_phase(f_active, b_active))
+        out.append(row)
+    return out
+
+
+def classify_phase(active_f: bool, active_b: bool) -> str:
+    if active_f and active_b:
+        return "steady"
+    if active_f:
+        return "warmup"
+    if active_b:
+        return "cooldown"
+    return "idle"
+
+
+def _wasted_fraction(counts: Dict[str, float], schedule: str) -> float:
+    """Wasted work over total: scan ticks are all-or-nothing; 1f1b
+    warmup/cooldown ticks do half their (F, B) work."""
+    total = sum(counts.values())
+    if not total:
+        return 0.0
+    if schedule == "scan":
+        return (total - counts.get("steady", 0.0)) / total
+    half = counts.get("warmup", 0.0) + counts.get("cooldown", 0.0)
+    return (counts.get("idle", 0.0) + 0.5 * half) / total
+
+
+def bubble_report(pp: int, n_micro: int, num_chunks: int = 1,
+                  schedule: str = "scan",
+                  tick_time_s: Optional[float] = None) -> dict:
+    """Analytic bubble accounting for one schedule configuration.
+
+    Returns total ticks, per-rank phase counts, the wasted-work fraction,
+    and the textbook reference fraction ``(p-1)/(m·vpp + p-1)`` for
+    comparison. With ``tick_time_s`` (a measured per-tick wall time) the
+    report also prices the bubble in milliseconds per step.
+    """
+    phases = tick_phases(pp, n_micro, num_chunks, schedule)
+    total = schedule_ticks(pp, n_micro, num_chunks, schedule)
+    per_rank = []
+    for r, row in enumerate(phases):
+        counts: Dict[str, int] = {}
+        for ph in row:
+            counts[ph] = counts.get(ph, 0) + 1
+        per_rank.append({"rank": r, "ticks": dict(counts)})
+    frac = analytic_bubble_fraction(pp, n_micro, num_chunks, schedule)
+    rep = {
+        "schedule": schedule,
+        "pp": pp,
+        "n_micro": n_micro,
+        "num_chunks": num_chunks,
+        "total_ticks": total,
+        "per_rank": per_rank,
+        "analytic_bubble_fraction": round(frac, 6),
+        "reference_bubble_fraction": round(
+            (pp - 1) / (n_micro * num_chunks + pp - 1), 6),
+    }
+    if tick_time_s is not None:
+        rep["tick_ms"] = round(tick_time_s * 1e3, 4)
+        rep["bubble_ms_per_step"] = round(frac * total * tick_time_s * 1e3, 4)
+        rep["step_ms"] = round(total * tick_time_s * 1e3, 4)
+    return rep
+
+
+class TickTimeline:
+    """Host-side collector for the schedules' ``tick_hook``.
+
+    Pass an instance as ``tick_hook=`` to ``pipeline_rounds`` /
+    ``pipeline_forward_backward`` / ``pipeline_forward_backward_1f1b``
+    (or ``microbatch_hook=`` to ``forward_backward_no_pipelining``); each
+    scan tick emits ``(t, rank, active_f, active_b)`` through an async
+    ``jax.debug.callback``. Call ``jax.effects_barrier()`` before
+    :meth:`report` to flush in-flight emissions.
+    """
+
+    def __init__(self):
+        self.events: List[dict] = []
+
+    def hook(self, t, rank, active_f, active_b) -> None:
+        self.events.append({
+            "tick": int(t),
+            "rank": int(rank),
+            "active_f": bool(active_f),
+            "active_b": bool(active_b),
+            "t_wall": time.perf_counter(),
+        })
+
+    __call__ = hook
+
+    def clear(self) -> None:
+        self.events = []
+
+    def report(self, schedule: str = "1f1b") -> dict:
+        """Measured warmup/steady/cooldown timeline per rank.
+
+        Durations are wall-time diffs between a rank's consecutive tick
+        arrivals (a rank's first tick has no duration and is excluded
+        from the time accounting, not from the counts). The measured
+        bubble fraction uses the same half-tick weighting as
+        :func:`analytic_bubble_fraction`, so the two are directly
+        comparable.
+        """
+        by_rank: Dict[int, List[dict]] = {}
+        for ev in self.events:
+            by_rank.setdefault(ev["rank"], []).append(ev)
+        ranks = []
+        agg_time: Dict[str, float] = {}
+        agg_count: Dict[str, float] = {}
+        for rank in sorted(by_rank):
+            evs = sorted(by_rank[rank], key=lambda e: (e["t_wall"],
+                                                       e["tick"]))
+            counts: Dict[str, int] = {}
+            times: Dict[str, float] = {}
+            timeline = []
+            prev_t = None
+            for ev in evs:
+                ph = classify_phase(ev["active_f"], ev["active_b"])
+                if schedule == "scan" and ph == "warmup":
+                    ph = "steady"  # forward-only tick: active == steady
+                counts[ph] = counts.get(ph, 0) + 1
+                agg_count[ph] = agg_count.get(ph, 0) + 1
+                dt = None
+                if prev_t is not None:
+                    dt = ev["t_wall"] - prev_t
+                    times[ph] = times.get(ph, 0.0) + dt
+                    agg_time[ph] = agg_time.get(ph, 0.0) + dt
+                prev_t = ev["t_wall"]
+                timeline.append({"tick": ev["tick"], "phase": ph,
+                                 "dt_s": dt})
+            ranks.append({
+                "rank": rank,
+                "ticks": counts,
+                "phase_seconds": {k: round(v, 6) for k, v in times.items()},
+                "timeline": timeline,
+            })
+        measured_time = _wasted_fraction(agg_time, schedule)
+        measured_ticks = _wasted_fraction(agg_count, schedule)
+        return {
+            "schedule": schedule,
+            "n_events": len(self.events),
+            "per_rank": ranks,
+            "phase_seconds": {k: round(v, 6) for k, v in agg_time.items()},
+            # tick-count accounting (exact) and wall-time accounting
+            # (approximate: async callback arrival)
+            "measured_bubble_fraction_ticks": round(measured_ticks, 6),
+            "measured_bubble_fraction_time": round(measured_time, 6),
+        }

@@ -1,0 +1,199 @@
+"""Host-side telemetry sinks: JSONL, ring buffer, fan-out.
+
+The reference's logging discipline is rank-0 prints plus a TensorBoard
+``SummaryWriter`` handed to ``Timers.write`` (duck-typed ``add_scalar``);
+the fork's scaling harness then scrapes stdout. These sinks replace the
+scrape with structured records: every recorder accepts free-form dicts
+via :meth:`record` AND implements the ``add_scalar(name, value, step)``
+writer protocol, so it drops into ``Timers.write`` unchanged.
+
+Rank gating follows the reference's rank-0 convention: by default only
+the logging process (data-parallel rank 0 — the process owning the first
+mesh device when ``parallel_state`` is initialized, else
+``jax.process_index() == 0``) writes; other ranks' records are dropped
+at the sink, so instrumented step functions stay identical across ranks
+(SPMD programs must not diverge).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def is_logging_process(log_rank: Optional[int] = None) -> bool:
+    """True on the process that should write telemetry.
+
+    ``log_rank=None`` (default) is the reference's rank-0 convention:
+    the process owning the first device of the ``parallel_state`` mesh
+    when initialized (data-parallel rank 0's host), else process 0.
+    An explicit ``log_rank`` pins ``jax.process_index() == log_rank``.
+    """
+    import jax
+
+    if log_rank is not None:
+        return jax.process_index() == int(log_rank)
+    try:
+        from ..transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            first = np.ravel(parallel_state.get_mesh().devices)[0]
+            return jax.process_index() == int(first.process_index)
+    except Exception:  # parallel_state unavailable/uninitialized
+        pass
+    return jax.process_index() == 0
+
+
+def _jsonable(v):
+    """Strict-JSON-safe conversion: numpy/jax scalars and arrays become
+    python numbers/lists, non-finite floats become their repr strings
+    (json has no inf/nan), unknown objects their repr."""
+    if isinstance(v, (str, bool, int, type(None))):
+        return v
+    if isinstance(v, float):
+        return v if np.isfinite(v) else repr(v)
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    try:
+        arr = np.asarray(v)  # numpy scalars/arrays, jax Arrays
+    except Exception:
+        return repr(v)
+    if arr.ndim == 0:
+        return _jsonable(arr.item())
+    return [_jsonable(x) for x in arr.tolist()]
+
+
+class NullRecorder:
+    """Drops everything (the non-logging ranks' sink)."""
+
+    def record(self, rec: dict) -> None:
+        pass
+
+    def add_scalar(self, name, value, step) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RingBufferRecorder(NullRecorder):
+    """In-memory ring of the last ``capacity`` records — the cheap
+    always-on sink for tests and interactive inspection."""
+
+    def __init__(self, capacity: int = 1024, *, only_logging_process=False,
+                 log_rank: Optional[int] = None):
+        self.records = collections.deque(maxlen=capacity)
+        self._enabled = (not only_logging_process
+                         or is_logging_process(log_rank))
+
+    def record(self, rec: dict) -> None:
+        if self._enabled:
+            self.records.append(dict(rec))
+
+    def add_scalar(self, name, value, step) -> None:
+        self.record({"event": "scalar", "name": str(name),
+                     "value": _jsonable(value), "step": _jsonable(step)})
+
+    def __len__(self):
+        return len(self.records)
+
+
+class JsonlRecorder(NullRecorder):
+    """Append-only JSONL file sink, one record per line.
+
+    Writes are flushed per record (drain cadence is the batching knob —
+    see :func:`apex_tpu.telemetry.drain`'s ``every_n``), and guarded by a
+    lock: async ``jax.debug.callback`` emissions may land from a runtime
+    thread. Only the logging process writes (``only_logging_process``,
+    default True — the MLPerf/Megatron rank-0 convention); other ranks
+    construct the recorder fine and silently drop records.
+    """
+
+    def __init__(self, path, *, only_logging_process: bool = True,
+                 log_rank: Optional[int] = None, append: bool = False):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._enabled = (not only_logging_process
+                         or is_logging_process(log_rank))
+        self._fh = None
+        if self._enabled:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a" if append else "w")
+
+    def record(self, rec: dict) -> None:
+        if self._fh is None:
+            return
+        rec = {k: _jsonable(v) for k, v in rec.items()}
+        rec.setdefault("t_wall", time.time())
+        line = json.dumps(rec)
+        with self._lock:
+            if self._fh is None:  # closed between check and write
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def add_scalar(self, name, value, step) -> None:
+        self.record({"event": "scalar", "name": str(name),
+                     "value": _jsonable(value), "step": _jsonable(step)})
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class MultiRecorder(NullRecorder):
+    """Fan a record out to several sinks (e.g. JSONL + ring buffer)."""
+
+    def __init__(self, *recorders):
+        self.recorders = list(recorders)
+
+    def record(self, rec: dict) -> None:
+        for r in self.recorders:
+            r.record(rec)
+
+    def add_scalar(self, name, value, step) -> None:
+        for r in self.recorders:
+            r.add_scalar(name, value, step)
+
+    def flush(self) -> None:
+        for r in self.recorders:
+            r.flush()
+
+    def close(self) -> None:
+        for r in self.recorders:
+            r.close()
+
+
+def read_jsonl(path) -> list:
+    """Parse a telemetry JSONL file back into a list of dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

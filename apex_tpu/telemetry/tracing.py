@@ -1,0 +1,281 @@
+"""Trace sessions and per-op device-time attribution.
+
+The reference publishes per-kernel timings through nvprof/nsys ranges;
+the TPU analogue is a ``jax.profiler`` xplane trace. This module owns
+
+- :func:`trace_session` — a context manager around ``jax.profiler.trace``
+  that yields a session handle whose :meth:`~TraceSession.op_breakdown`
+  parses the captured device plane into a categorized top-op table;
+- :func:`profile_step` — one-shot: run a step function ``n_steps`` times
+  under a trace and return the breakdown table, falling back to the
+  compiled step's ``cost_analysis()`` (flops/bytes attribution) on
+  backends with no device plane (CPU CI) so every environment gets a
+  table rather than ``None``;
+- the pure xplane/HLO op-name helpers (:func:`short_op_name`,
+  :func:`categorize_op`, :func:`aggregate_op_times`,
+  :func:`breakdown_table`) — factored out of ``tools/op_breakdown.py``
+  so they unit-test on canned fixtures without a TPU or tensorflow.
+
+``tools/op_breakdown.py`` re-exports all of this for script use.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import re
+import tempfile
+from collections import defaultdict
+from typing import Dict, Iterable, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# pure helpers (fixture-testable, no jax/tf imports)
+# ---------------------------------------------------------------------------
+
+def short_op_name(hlo_text: str) -> str:
+    """'%convolution_tanh_fusion.3 = bf16[...] ...' -> 'convolution_tanh_fusion'."""
+    name = hlo_text.split(" = ", 1)[0].strip()
+    name = name.lstrip("%")
+    return re.sub(r"\.\d+$", "", name)
+
+
+_CATEGORIES = (
+    ("flash|attention", "attention-kernel"),
+    ("custom-call", "custom-call"),
+    ("convolution|dot|gemm", "matmul/conv"),
+    ("all-reduce|all-gather|reduce-scatter|collective|permute", "collective"),
+    ("copy|transpose|bitcast|reshape", "data-movement"),
+    ("scatter|gather|dynamic", "gather/scatter"),
+    ("reduce", "reduce"),
+    ("fusion", "fusion(elementwise)"),
+)
+
+# container ops (while/conditional) span their body ops, which are ALSO
+# events on the XLA Ops line — counting both would double the loop time
+_CONTAINER_PREFIXES = ("while", "conditional")
+
+
+def categorize_op(op: str) -> str:
+    low = op.lower()
+    for pat, cat in _CATEGORIES:
+        if re.search(pat, low):
+            return cat
+    return "other"
+
+
+def aggregate_op_times(
+    events: Iterable[Tuple[str, int]],
+) -> Tuple[int, Dict[str, int]]:
+    """Fold raw ``(hlo_op_text, duration_ps)`` events into
+    ``(total_ps, {short_op_name: ps})``, dropping container ops.
+
+    This is the parsing core of the xplane breakdown, taking already
+    decoded events so it is unit-testable on a canned fixture (no
+    tensorflow protobuf needed).
+    """
+    per_op: Dict[str, int] = defaultdict(int)
+    total = 0
+    for raw, ps in events:
+        name = short_op_name(raw)
+        if name.startswith(_CONTAINER_PREFIXES):
+            continue
+        per_op[name] += int(ps)
+        total += int(ps)
+    return total, dict(per_op)
+
+
+def breakdown_table(total_ps: int, per_op: Dict[str, int],
+                    n_steps: int = 1, top: int = 10) -> Optional[dict]:
+    """The published table: top-``top`` ops + per-category totals.
+
+    Ops on the device ``XLA Ops`` line are leaf HLO instructions, so
+    durations are self-times. Returns ``None`` when nothing was captured.
+    """
+    if not total_ps:
+        return None
+    rows = sorted(per_op.items(), key=lambda kv: -kv[1])
+    ops = [
+        {
+            "op": name,
+            "category": categorize_op(name),
+            "ms_per_step": round(ps / 1e9 / n_steps, 3),
+            "pct": round(100.0 * ps / total_ps, 2),
+        }
+        for name, ps in rows[:top]
+    ]
+    by_cat: Dict[str, int] = defaultdict(int)
+    for name, ps in per_op.items():
+        by_cat[categorize_op(name)] += ps
+    categories = {
+        cat: {
+            "ms_per_step": round(ps / 1e9 / n_steps, 3),
+            "pct": round(100.0 * ps / total_ps, 2),
+        }
+        for cat, ps in sorted(by_cat.items(), key=lambda kv: -kv[1])
+    }
+    return {
+        "source": "xplane",
+        "device_ms_per_step": round(total_ps / 1e9 / n_steps, 3),
+        "ops": ops,
+        "categories": categories,
+    }
+
+
+# ---------------------------------------------------------------------------
+# xplane extraction (needs the tensorflow protobuf; TPU images have it)
+# ---------------------------------------------------------------------------
+
+def iter_xplane_events(trace_dir: str):
+    """Yield ``(raw_op_name, duration_ps)`` for every event on a device
+    plane's ``XLA Ops`` line under ``trace_dir``. Empty iterator when the
+    tensorflow protobuf is unavailable or nothing was captured."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:  # tensorflow not present on this image
+        return
+    for path in glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    ):
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if "/device:TPU" not in plane.name:
+                continue
+            for line in plane.lines:
+                if line.name != "XLA Ops":
+                    continue
+                for ev in line.events:
+                    md = plane.event_metadata[ev.metadata_id]
+                    yield md.name, ev.duration_ps
+
+
+def parse_xspace_op_times(trace_dir: str) -> Tuple[int, Dict[str, int]]:
+    """Aggregate XLA-op self-times from every .xplane.pb under
+    ``trace_dir``: ``(total_ps, {op_name: ps})`` summed over all captured
+    device planes and steps."""
+    return aggregate_op_times(iter_xplane_events(trace_dir))
+
+
+# ---------------------------------------------------------------------------
+# sessions
+# ---------------------------------------------------------------------------
+
+class TraceSession:
+    """Handle to one profiler capture (yielded by :func:`trace_session`)."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self.active = True
+
+    def op_breakdown(self, n_steps: int = 1, top: int = 10):
+        """Parse the capture into a categorized table (after the ``with``
+        block exits). ``None`` when no device plane was captured."""
+        if self.active:
+            raise RuntimeError(
+                "trace_session is still active — parse after the with "
+                "block exits (the profiler writes the xplane on stop)")
+        total_ps, per_op = parse_xspace_op_times(self.logdir)
+        return breakdown_table(total_ps, per_op, n_steps=n_steps, top=top)
+
+
+@contextlib.contextmanager
+def trace_session(logdir: Optional[str] = None):
+    """Capture a ``jax.profiler`` trace around a block of training code.
+
+    Yields a :class:`TraceSession`; after the block exits, call
+    ``session.op_breakdown(n_steps=...)`` for the categorized device-time
+    table, or point ``tensorboard --logdir`` / Perfetto at
+    ``session.logdir`` for the full timeline (named scopes from
+    ``jax.named_scope`` — ``apex_tpu.flash_attention``,
+    ``apex_tpu.packed_adam``, ``apex_tpu.pipeline_rounds``, ... —
+    annotate the op names).
+
+    ::
+
+        with telemetry.trace_session("/tmp/trace") as sess:
+            for _ in range(3):
+                state = step(*state)
+            jax.block_until_ready(state)
+        table = sess.op_breakdown(n_steps=3)
+    """
+    import jax
+
+    d = logdir or tempfile.mkdtemp(prefix="apex_tpu_trace_")
+    session = TraceSession(d)
+    try:
+        with jax.profiler.trace(d):
+            yield session
+    finally:
+        # the profiler has stopped (and written the xplane) even when
+        # the traced block raised — the partial capture stays parseable
+        session.active = False
+
+
+def cost_analysis_breakdown(step_fn, state) -> Optional[dict]:
+    """Static flops/bytes attribution from ``Compiled.cost_analysis()``.
+
+    The off-TPU fallback: no device timeline exists on the CPU backend,
+    but XLA's post-optimization cost model still attributes the step's
+    algorithmic work — enough for CI to catch a step whose flops or
+    traffic regress. Returns ``None`` only if even compilation fails.
+    """
+    import jax
+
+    try:
+        lower = getattr(step_fn, "lower", None)
+        if lower is None:
+            lower = jax.jit(step_fn).lower
+        ca = lower(*state).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        ca = dict(ca or {})
+    except Exception:
+        return None
+    flops = float(ca.get("flops", 0.0))
+    bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    return {
+        "source": "cost_analysis",
+        "device_ms_per_step": None,  # static model: no timing off-TPU
+        "flops_per_step": flops,
+        "gflops_per_step": round(flops / 1e9, 3),
+        "bytes_accessed_per_step": bytes_accessed,
+        "transcendentals_per_step": float(ca.get("transcendentals", 0.0)),
+        "arithmetic_intensity": (
+            round(flops / bytes_accessed, 3) if bytes_accessed else None),
+        "ops": [],
+        "categories": {},
+    }
+
+
+def profile_step(step_fn, state, n_steps: int = 3, top: int = 10):
+    """One-shot step profile: trace ``n_steps`` chained executions and
+    return the top-``top`` device-time table, or the
+    ``cost_analysis()`` attribution on backends with no device plane.
+
+    ``step_fn(*state) -> state`` must be chainable (the bench step
+    contract). The final state is fenced inside the trace so every step
+    is captured.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        # no device plane exists to capture — skip the n_steps of traced
+        # execution entirely and go straight to the static attribution
+        return cost_analysis_breakdown(step_fn, state)
+    with trace_session() as sess:
+        cur = state
+        for _ in range(n_steps):
+            cur = step_fn(*cur)
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready()
+            if hasattr(x, "block_until_ready") else x,
+            cur[-1],
+        )
+    table = sess.op_breakdown(n_steps=n_steps, top=top)
+    if table is not None:
+        return table
+    # no device plane (CPU backend, or tensorflow protobuf missing):
+    # static attribution instead of None
+    return cost_analysis_breakdown(step_fn, state)

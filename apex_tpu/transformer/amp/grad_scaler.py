@@ -65,8 +65,8 @@ class GradScaler(LossScaler):
             found_inf=self._allreduce_found_inf(new_state.found_inf)
         )
 
-    def update_scale(self, state: LossScaleState) -> LossScaleState:
+    def update_scale(self, state: LossScaleState, metrics=None):
         synced = state._replace(
             found_inf=self._allreduce_found_inf(state.found_inf)
         )
-        return super().update_scale(synced)
+        return super().update_scale(synced, metrics)

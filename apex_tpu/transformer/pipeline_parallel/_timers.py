@@ -57,15 +57,36 @@ class _Timer:
 
 
 class Timers:
-    """Reference ``_timers.py:52-83``."""
+    """Reference ``_timers.py:52-83``.
 
-    def __init__(self):
+    ``log_rank`` picks the printing process: ``None`` (default) follows
+    the reference's rank-0 convention — the process hosting data-parallel
+    rank 0 when ``parallel_state`` is initialized (the first mesh
+    device's process), else process 0. (The original port hardcoded
+    LAST-process printing, which matched no reference convention.) An
+    int pins an explicit ``jax.process_index()``.
+
+    ``sink`` is an optional telemetry recorder
+    (``apex_tpu.telemetry.JsonlRecorder`` / ``RingBufferRecorder`` / any
+    ``add_scalar`` writer): :meth:`log` then also emits each timer value
+    as a structured record, and :meth:`write` accepts the same recorders
+    via its duck-typed ``writer`` argument as before.
+    """
+
+    def __init__(self, log_rank=None, sink=None):
         self.timers: Dict[str, _Timer] = {}
+        self.log_rank = log_rank
+        self.sink = sink
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
             self.timers[name] = _Timer(name)
         return self.timers[name]
+
+    def _should_log(self) -> bool:
+        from ...telemetry.recorder import is_logging_process
+
+        return is_logging_process(self.log_rank)
 
     def write(self, names, writer, iteration, normalizer=1.0, reset=False):
         assert normalizer > 0.0
@@ -73,15 +94,21 @@ class Timers:
             value = self.timers[name].elapsed(reset=reset) / normalizer
             writer.add_scalar(f"{name}-time", value, iteration)
 
-    def log(self, names=None, normalizer=1.0, reset=True) -> str:
+    def log(self, names=None, normalizer=1.0, reset=True,
+            iteration=None) -> str:
         assert normalizer > 0.0
         names = names if names is not None else list(self.timers)
         string = "time (ms)"
+        values = {}
         for name in names:
             elapsed_time = (
                 self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
             )
+            values[name] = elapsed_time
             string += f" | {name}: {elapsed_time:.2f}"
-        if jax.process_index() == jax.process_count() - 1:
+        if self.sink is not None:
+            self.sink.record({"event": "timers", "iteration": iteration,
+                              "ms": values})
+        if self._should_log():
             print(string, flush=True)
         return string

@@ -65,6 +65,46 @@ def build_model(
 def _listify(x):
     return x if isinstance(x, list) else [x]
 
+
+def emit_tick(hook, t, rank, active_f, active_b) -> None:
+    """Emit one schedule tick to a telemetry hook, asynchronously.
+
+    ``hook`` is host-side — a callable or an object with ``.hook`` (e.g.
+    :class:`apex_tpu.telemetry.TickTimeline`) receiving ``(t, rank,
+    active_f, active_b)`` as numpy scalars. The emission is a
+    ``jax.debug.callback``: it never blocks the step and adds no host
+    sync. jax's partial-eval drops debug callbacks from scans that are
+    differentiated THROUGH, so hooks fire for forward-only runs of the
+    autodiff pipeline schedules and always for the schedules whose scan
+    is never itself differentiated (true-1F1B — backward runs inside the
+    scan — and no-pipelining, whose grad runs inside the body); callers
+    that request a hook on a path autodiff will swallow get a one-time
+    warning from the schedule.
+    """
+    if hook is None:
+        return
+    cb = getattr(hook, "hook", hook)
+    jax.debug.callback(cb, t, rank, active_f, active_b)
+
+
+_warned_hook_autodiff: set = set()
+
+
+def warn_hook_under_autodiff(fn_name: str) -> None:
+    """One-time heads-up that a tick_hook threaded into a schedule whose
+    scan gets differentiated will not fire (debug callbacks are dropped
+    by linearization in current jax)."""
+    if fn_name in _warned_hook_autodiff:
+        return
+    _warned_hook_autodiff.add(fn_name)
+    warnings.warn(
+        f"{fn_name}: tick_hook on the autodiff (value_and_grad) path — "
+        "jax drops debug callbacks from differentiated scans, so the "
+        "hook will not fire. Use forward_only=True or the 1F1B schedule "
+        "(pipeline_forward_backward_1f1b) for a full F+B timeline.",
+        stacklevel=3,
+    )
+
 # kwargs the reference schedules take whose MECHANICS XLA owns on TPU
 # (shape plumbing, stream sync, buffer deallocation) — silently ignorable
 _MECHANICAL_PARITY_KWARGS = frozenset({

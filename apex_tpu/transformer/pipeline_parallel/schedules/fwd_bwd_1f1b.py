@@ -64,6 +64,7 @@ import jax.numpy as jnp
 
 from ... import parallel_state
 from ..utils import pvary_union_like
+from .common import emit_tick
 
 Pytree = Any
 
@@ -79,6 +80,7 @@ def pipeline_forward_backward_1f1b(
     grad_scaler: Optional[Callable] = None,
     with_dinputs: bool = True,
     num_chunks: int = 1,
+    tick_hook=None,
 ):
     """1F1B forward+backward inside ``shard_map``; same contract as
     :func:`pipeline_forward_backward`: returns ``(mean_loss, grads,
@@ -92,6 +94,13 @@ def pipeline_forward_backward_1f1b(
     ``c`` on stage ``s`` holds global layer block ``c*pp + s``, the
     reference layout); ``grads`` come back in the same stacked shape.
     Requires ``n_micro % pp == 0`` (the reference asserts the same).
+
+    ``tick_hook`` (e.g. ``apex_tpu.telemetry.TickTimeline``) receives an
+    async per-double-tick ``(t, rank, active_f, active_b)`` emission —
+    the measured warmup (F-only) / steady (1F1B) / cooldown (B-only)
+    timeline. This schedule's scan is never differentiated (the backward
+    runs inside it), so unlike the autodiff schedules the hook always
+    fires; zero host syncs added (``jax.debug.callback``).
 
     ``with_dinputs=False`` skips the input-gradient accumulation and
     returns ``dinputs=None``. The dinputs buffer is ``[n_micro, ...]`` —
@@ -202,6 +211,8 @@ def pipeline_forward_backward_1f1b(
         # which visits chunks in reverse order (vpp-1 first) ------------
         vb_raw = t - D - (pp - 1 - rank)
         active_b = (vb_raw >= 0) & (vb_raw < nv)
+        if tick_hook is not None:
+            emit_tick(tick_hook, t, rank, active_f, active_b)
         vb = jnp.clip(vb_raw, 0, nv - 1)
         kb = (vb // pp) % vpp
         cb = (vpp - 1) - kb

@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .common import warn_ignored_parity_kwargs
+from .common import emit_tick, warn_ignored_parity_kwargs
 
 Pytree = Any
 
@@ -30,6 +30,7 @@ def forward_backward_no_pipelining(
     *,
     forward_only: bool = False,
     grad_scaler: Optional[Callable] = None,
+    microbatch_hook=None,
     **parity_kwargs,
 ):
     """Run every microbatch through the full model, accumulating.
@@ -49,6 +50,13 @@ def forward_backward_no_pipelining(
     Accepted-for-parity kwargs: mechanical ones (``tensor_shape``,
     ``dtype``, ...) are ignored silently — XLA owns those mechanics;
     semantic ones (``custom_sync_context_handler``, ...) warn once.
+
+    ``microbatch_hook`` receives an async per-microbatch ``(i, 0, True,
+    not forward_only)`` telemetry emission (see
+    ``apex_tpu.telemetry.TickTimeline``). Unlike the pipelined autodiff
+    schedules, this scan is never differentiated THROUGH (the
+    ``value_and_grad`` runs inside the body), so the hook fires on both
+    the forward-only and the gradient path.
     """
     warn_ignored_parity_kwargs("forward_backward_no_pipelining", parity_kwargs)
     n = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
@@ -63,19 +71,33 @@ def forward_backward_no_pipelining(
     if extras is None:
         extras = jnp.zeros((n,))
 
+    # microbatch indices ride the scan only when a hook asks for them,
+    # keeping the un-instrumented program untouched
+    scan_xs = (microbatches, extras)
+    if microbatch_hook is not None:
+        scan_xs = (jnp.arange(n),) + scan_xs
+
+    def unpack(xs):
+        if microbatch_hook is None:
+            return xs
+        i, mb, ex = xs
+        emit_tick(microbatch_hook, i, jnp.int32(0),
+                  jnp.asarray(True), jnp.asarray(not forward_only))
+        return mb, ex
+
     if forward_only:
         def body(acc, xs):
-            mb, ex = xs
+            mb, ex = unpack(xs)
             return acc + one_loss(params, mb, ex), None
 
-        total, _ = jax.lax.scan(body, 0.0, (microbatches, extras))
+        total, _ = jax.lax.scan(body, 0.0, scan_xs)
         return total / n, None
 
     grad_fn = jax.value_and_grad(one_loss)
 
     def body(carry, xs):
         acc_loss, acc_grads = carry
-        mb, ex = xs
+        mb, ex = unpack(xs)
         loss, grads = grad_fn(params, mb, ex)
         new_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
         return (acc_loss + loss, new_grads), None
@@ -84,7 +106,7 @@ def forward_backward_no_pipelining(
         lambda p: jnp.zeros(p.shape, jnp.float32), params
     )
     (total, grads), _ = jax.lax.scan(
-        body, (0.0, zero_grads), (microbatches, extras)
+        body, (0.0, zero_grads), scan_xs
     )
     grads = jax.tree_util.tree_map(lambda g: g / n, grads)
     return total / n, grads

@@ -58,6 +58,7 @@ def pipeline_forward_backward_interleaved(
     # warn under THIS function's name and don't forward — forwarding would
     # misattribute the warning and collapse the warn-once dedup key
     tick_checkpoint = parity_kwargs.pop("tick_checkpoint", None)
+    tick_hook = parity_kwargs.pop("tick_hook", None)
     warn_ignored_parity_kwargs(
         "pipeline_forward_backward_interleaved", parity_kwargs)
     vpp = parallel_state.get_virtual_pipeline_model_parallel_world_size()
@@ -68,6 +69,7 @@ def pipeline_forward_backward_interleaved(
         forward_only=forward_only, axis_name=axis_name,
         checkpoint_stages=checkpoint_stages, grad_scaler=grad_scaler,
         num_chunks=vpp, tick_checkpoint=tick_checkpoint,
+        tick_hook=tick_hook,
     )
 
 
@@ -82,6 +84,7 @@ def run_pipeline_interleaved(
     forward_only: bool = False,
     checkpoint_stages: bool = True,
     tick_checkpoint=None,
+    tick_hook=None,
 ):
     """Single-axis wrapper; ``stage_params_chunks`` leaves are
     ``[pp, vpp, ...]``, pipeline-sharded on the first axis.
@@ -93,6 +96,7 @@ def run_pipeline_interleaved(
         mesh, stage_fn, loss_fn, stage_params_chunks, inputs, extras,
         forward_only=forward_only, checkpoint_stages=checkpoint_stages,
         num_chunks=vpp, tick_checkpoint=tick_checkpoint,
+        tick_hook=tick_hook,
     )
 
 

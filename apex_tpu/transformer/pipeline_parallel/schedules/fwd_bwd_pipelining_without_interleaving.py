@@ -40,7 +40,11 @@ import jax.numpy as jnp
 
 from ... import parallel_state
 from ..utils import pvary_union_like, vma_tracking_active
-from .common import warn_ignored_parity_kwargs
+from .common import (
+    emit_tick,
+    warn_hook_under_autodiff,
+    warn_ignored_parity_kwargs,
+)
 
 Pytree = Any
 
@@ -54,6 +58,7 @@ def pipeline_rounds(
     checkpoint_stages: bool,
     num_chunks: Optional[int] = None,
     tick_checkpoint: Optional[int] = None,
+    tick_hook=None,
 ) -> jax.Array:
     """Stream all microbatches through ``vpp = len(chunks)`` traversals of
     the stage ring in ONE continuous scan of ``n·vpp + pp − 1`` ticks —
@@ -120,6 +125,12 @@ def pipeline_rounds(
         # the item this rank processes entered stage 0 at tick u
         u = jnp.clip(t - rank, 0, n * vpp - 1)
         c = (u // pp) % vpp  # chunk this rank applies at tick t
+        if tick_hook is not None:
+            # telemetry: async per-tick emission (t, rank, active, no-B);
+            # inactive ticks are this schedule's masked-garbage bubble
+            emit_tick(tick_hook, t, rank,
+                      (t - rank >= 0) & (t - rank < n * vpp),
+                      jnp.asarray(False))
         # stage 0 injects a fresh microbatch on its chunk-0 ticks; on other
         # ticks it consumes the wrap-around from the last stage
         inject_now = (t // pp) % vpp == 0
@@ -247,6 +258,7 @@ def pipeline_forward_backward(
     grad_scaler: Optional[Callable] = None,
     num_chunks: int = 1,
     tick_checkpoint: Optional[int] = None,
+    tick_hook=None,
     **parity_kwargs,
 ):
     """Local (inside-shard_map) 1F1B-equivalent forward+backward.
@@ -272,8 +284,15 @@ def pipeline_forward_backward(
 
     Mechanical parity kwargs are ignored silently; semantic ones
     (``custom_sync_context_handler``, ...) warn once.
+
+    ``tick_hook`` (e.g. ``apex_tpu.telemetry.TickTimeline``) receives an
+    async per-tick ``(t, rank, active_f, active_b)`` emission for bubble
+    accounting — forward-only runs only: jax drops debug callbacks from
+    the differentiated scan (warned once).
     """
     warn_ignored_parity_kwargs("pipeline_forward_backward", parity_kwargs)
+    if tick_hook is not None and not forward_only:
+        warn_hook_under_autodiff("pipeline_forward_backward")
     a = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
     pp = jax.lax.axis_size(a)
     rank = jax.lax.axis_index(a)
@@ -285,6 +304,7 @@ def pipeline_forward_backward(
         outs = pipeline_rounds(
             stage_fn, params, inputs, a, checkpoint_stages,
             num_chunks=num_chunks, tick_checkpoint=tick_checkpoint,
+            tick_hook=tick_hook,
         )
 
         # emit per-microbatch losses and sum after — no carry, so neither
@@ -341,6 +361,7 @@ def run_pipeline(
     checkpoint_stages: bool = True,
     num_chunks: int = 1,
     tick_checkpoint: Optional[int] = None,
+    tick_hook=None,
 ):
     """Convenience single-axis wrapper: shard_map the local schedule over the
     ``pipeline`` mesh axis. ``stage_params`` leaves carry a leading ``[pp]``
@@ -364,7 +385,7 @@ def run_pipeline(
                 stage_fn, loss_fn, params, inputs, extras,
                 forward_only=True, axis_name=ax,
                 checkpoint_stages=checkpoint_stages, num_chunks=num_chunks,
-                tick_checkpoint=tick_checkpoint,
+                tick_checkpoint=tick_checkpoint, tick_hook=tick_hook,
             )
             return loss
 
@@ -379,7 +400,7 @@ def run_pipeline(
             stage_fn, loss_fn, params, inputs, extras,
             forward_only=False, axis_name=ax,
             checkpoint_stages=checkpoint_stages, num_chunks=num_chunks,
-            tick_checkpoint=tick_checkpoint,
+            tick_checkpoint=tick_checkpoint, tick_hook=tick_hook,
         )
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
         return loss, grads, dinp

@@ -133,27 +133,68 @@ def _retry_transient(fn, attempts=3, tag="bench leg"):
                   f"({attempt + 1}/{attempts - 1})", file=_sys.stderr)
 
 
-def _timed_steps(step_fn, state, iters):
+# every bench leg streams per-step + summary records here
+# (BENCH_TELEMETRY_JSONL overrides the path; see docs/observability.md)
+_TELEMETRY_RECORDER = None
+
+
+def telemetry_recorder():
+    global _TELEMETRY_RECORDER
+    if _TELEMETRY_RECORDER is None:
+        from apex_tpu.telemetry import JsonlRecorder
+
+        _TELEMETRY_RECORDER = JsonlRecorder(os.environ.get(
+            "BENCH_TELEMETRY_JSONL", "/tmp/apex_tpu_bench_telemetry.jsonl"))
+    return _TELEMETRY_RECORDER
+
+
+def _timed_steps(step_fn, state, iters, leg=None):
     """Run chained steps via the Megatron-style Timers (the reference's
     ``_Timer``/``Timers`` instrumentation, ``pipeline_parallel/_timers.py``);
-    returns (dt_seconds, final_loss)."""
+    returns (dt_seconds, final_loss).
+
+    Each step emits a per-step JSONL record through the telemetry
+    recorder (dispatch-side wall timestamps — no sync; in-jit metric
+    drains ride the instrumented legs separately), and the leg emits a
+    summary record after the timed region.
+    """
+    import time as _time
+
     from apex_tpu.transformer.pipeline_parallel._timers import Timers
 
-    timers = Timers()
+    rec = telemetry_recorder()
+    timers = Timers(sink=rec)
     for _ in range(2):  # compile + warm
         state = step_fn(*state)
     float(state[-1])
+    # timestamps buffer in memory inside the timed region (appending a
+    # tuple is ~ns); the file writes happen after the timer stops so the
+    # published step time never includes host JSON/IO work
+    stamps = []
     timers("train-steps").start()
-    for _ in range(iters):
+    for i in range(iters):
         state = step_fn(*state)
+        stamps.append(_time.perf_counter())
     final_loss = float(state[-1])  # true sync
     timers("train-steps").stop()
-    return timers("train-steps").elapsed(reset=False), final_loss, state
+    dt = timers("train-steps").elapsed(reset=False)
+    for i, t in enumerate(stamps):
+        rec.record({"event": "step", "leg": leg, "step": i,
+                    "t_dispatch": t})
+    rec.record({"event": "leg_summary", "leg": leg, "iters": iters,
+                "step_ms": round(dt / iters * 1e3, 3),
+                "final_loss": float(final_loss)})
+    return dt, final_loss, state
 
 
 def bench_gpt(iters, batch, seq, remat, master_weights=True,
               ce_save_logits=None, capture_state=False, fp8=False,
-              packed=None):
+              packed=None, telemetry_every=0, leg="gpt"):
+    """``telemetry_every > 0`` instruments the (non-fp8) train step with
+    the in-jit ``telemetry.MetricsState`` — loss/tokens accumulated on
+    device, drained to the bench JSONL every N steps through an async
+    callback. Sync-free by construction; the ``telemetry_overhead`` leg
+    A/Bs this against the bare step."""
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import (
         GPTConfig, gpt_loss, init_gpt_fp8_carriers, init_gpt_fp8_states,
@@ -165,7 +206,11 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
         # rematerialised (the round-5 profile: -8 ms/step at remat=none)
         ce_save_logits = not remat
     cfg = GPTConfig(
-        num_layers=24, num_attention_heads=16, hidden_size=1024,
+        # BENCH_GPT_LAYERS shrinks the model for CPU smoke runs (the
+        # 345M default takes ~30 s/step on a CPU host); the published
+        # TPU numbers always use the 24-layer default
+        num_layers=int(os.environ.get("BENCH_GPT_LAYERS", "24")),
+        num_attention_heads=16, hidden_size=1024,
         vocab_size=50304, max_position_embeddings=seq,
         hidden_dropout=0.0, attention_dropout=0.0,
         compute_dtype=jnp.bfloat16, recompute_granularity=remat or None,
@@ -220,6 +265,24 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
         # buffers); the states are KB-sized, so copying them is free
         train_step = jax.jit(train_step, donate_argnums=(0, 1))
         state = (params, opt_state, fp8_states, jnp.float32(0))
+    elif telemetry_every > 0:
+        from apex_tpu import telemetry
+
+        rec = telemetry_recorder()
+
+        def train_step(params, opt_state, metrics, loss_prev):
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt_loss(cfg, p, tokens, labels))(params)
+            params, opt_state = opt.step(grads, opt_state, params)
+            metrics = telemetry.accumulate(
+                metrics, loss=loss, tokens=batch * seq)
+            metrics = telemetry.drain(
+                metrics, rec, every_n=telemetry_every, tag=leg)
+            return params, opt_state, metrics, loss
+
+        train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        state = (params, opt_state, telemetry.init_metrics(),
+                 jnp.float32(0))
     else:
         def train_step(params, opt_state, loss_prev):
             loss, grads = jax.value_and_grad(
@@ -229,7 +292,7 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
 
         train_step = jax.jit(train_step, donate_argnums=(0, 1))
         state = (params, opt_state, jnp.float32(0))
-    dt, final_loss, state = _timed_steps(train_step, state, iters)
+    dt, final_loss, state = _timed_steps(train_step, state, iters, leg=leg)
     flops = train_flops_per_step(
         cfg.num_layers, cfg.hidden_size, cfg.ffn_size, cfg.vocab_size,
         batch, seq, causal=True)
@@ -249,13 +312,14 @@ _gpt_step_for_breakdown = None
 
 def gpt_op_breakdown(top=10):
     """Top-op device-time table for the headline GPT step (VERDICT r4 #1:
-    publish WHERE the milliseconds go). None off-TPU or if tracing or the
-    xplane parse is unavailable. Releases the retained train state either
-    way — ~5 GB of params+opt state must not stay live through the
-    BERT/ResNet benches."""
+    publish WHERE the milliseconds go). Off-TPU this is the
+    ``cost_analysis()`` flops/bytes attribution (no device plane exists),
+    so CPU runs publish a table too. None only if profiling itself
+    fails. Releases the retained train state either way — ~5 GB of
+    params+opt state must not stay live through the BERT/ResNet
+    benches."""
     global _gpt_step_for_breakdown
-    if _gpt_step_for_breakdown is None or jax.default_backend() != "tpu":
-        _gpt_step_for_breakdown = None
+    if _gpt_step_for_breakdown is None:
         return None
     try:
         import sys
@@ -283,7 +347,8 @@ def bench_gpt_fp8(iters, batch, seq):
     ratio is expected <= 1 (no native fp8 MXU; the dequant work is
     overhead) — the artifact is the wiring; fp8-capable chips inherit
     the speedup."""
-    dt, final_loss, _ = bench_gpt(iters, batch, seq, "", fp8=True)
+    dt, final_loss, _ = bench_gpt(iters, batch, seq, "", fp8=True,
+                                  leg="gpt_fp8")
     return dt, final_loss
 
 
@@ -326,7 +391,8 @@ def bench_bert_lamb(iters, batch, seq):
 
     train_step = jax.jit(train_step, donate_argnums=(0, 1))
     dt, final_loss, _ = _timed_steps(
-        train_step, (params, opt_state, jnp.float32(0)), iters)
+        train_step, (params, opt_state, jnp.float32(0)), iters,
+        leg="bert_large_lamb")
     flops = train_flops_per_step(
         cfg.num_layers, cfg.hidden_size, cfg.ffn_size, cfg.vocab_size,
         batch, seq, causal=False)
@@ -388,7 +454,7 @@ def bench_resnet_o2(iters, batch):
     bytes_accessed = float(ca.get("bytes accessed", 0.0))
     dt, final_loss, _ = _timed_steps(
         compiled, (params, bstats, opt_state, sstate, jnp.float32(0)),
-        iters)
+        iters, leg=f"resnet50_o2_b{batch}")
     return dt / iters, final_loss, flops, bytes_accessed
 
 
@@ -470,8 +536,49 @@ def bench_packed_optimizer(iters, hbm_gbps=819.0, hbm_recognised=False):
         float(jnp.asarray(params[keys[0]][0], jnp.float32))
         return (time.perf_counter() - t0) / iters
 
+    def drain_gbps(n_drains=6):
+        """Short telemetry-instrumented run: the packed step carries a
+        MetricsState drained EVERY step with ``bytes_per_step`` set to
+        the state's minimum sweep traffic, so each JSONL drain record
+        reports achieved GB/s for that window (host wall dt between
+        async drains — conservative, never a device sync)."""
+        from apex_tpu import telemetry
+
+        params = {k: jnp.zeros((leaf,), jnp.bfloat16) for k in keys}
+        grads = {k: jnp.full((leaf,), 1e-3, jnp.bfloat16) for k in keys}
+        opt = FusedAdam(lr=1e-3, master_weights=True, packed=True)
+        state = opt.init(params)
+        bps = state.sweep_bytes()
+        ring = telemetry.RingBufferRecorder()
+        rec = telemetry.MultiRecorder(telemetry_recorder(), ring)
+
+        def stepfn(g, s, p, m):
+            p2, s2 = opt.step(g, s, p)
+            m = telemetry.accumulate(m)
+            m = telemetry.drain(m, rec, every_n=1,
+                                tag="packed_optimizer", bytes_per_step=bps)
+            return p2, s2, m
+
+        step = jax.jit(stepfn, donate_argnums=(1, 2, 3))
+        m = telemetry.init_metrics()
+        params, state, m = step(grads, state, params, m)  # compile+warm
+        for _ in range(n_drains):
+            params, state, m = step(grads, state, params, m)
+        jax.effects_barrier()
+        vals = sorted(r["achieved_gbps"] for r in ring.records
+                      if "achieved_gbps" in r)
+        return vals[len(vals) // 2] if vals else None
+
     t_packed = _retry_transient(lambda: measure(True), tag="packed opt")
     t_pytree = _retry_transient(lambda: measure(False), tag="pytree opt")
+    try:
+        gbps_per_drain = drain_gbps()
+    except Exception as e:  # telemetry must never sink the bench
+        import sys as _sys
+
+        print(f"packed drain telemetry failed: {type(e).__name__}: {e}",
+              file=_sys.stderr)
+        gbps_per_drain = None
     bytes_min = 28 * n_params
     return {
         "n_params": n_params,
@@ -479,6 +586,10 @@ def bench_packed_optimizer(iters, hbm_gbps=819.0, hbm_recognised=False):
         "pytree_step_ms": round(t_pytree * 1000.0, 3),
         "vs_pytree": round(t_pytree / t_packed, 4),  # >1: packed faster
         "gbps_achieved": round(bytes_min / t_packed / 1e9, 1),
+        # median of the per-drain telemetry records (each drain's own
+        # achieved GB/s is in the JSONL, tag=packed_optimizer)
+        "gbps_per_drain": (round(gbps_per_drain, 1)
+                           if gbps_per_drain else None),
         "hbm_gbps_nameplate": hbm_gbps if hbm_recognised else None,
         "pct_of_nameplate": (
             round(bytes_min / t_packed / 1e9 / hbm_gbps, 4)
@@ -543,16 +654,48 @@ def main() -> None:
 
     peak, recognised, hbm_gbps, hbm_recognised = detect_peaks()
 
+    # off-TPU the op breakdown is the (cheap) cost-analysis fallback, so
+    # CPU runs — fast or not — always publish a table
+    want_breakdown = not fast or jax.default_backend() != "tpu"
     step_s, final_loss, flops = _retry_transient(
         lambda: bench_gpt(iters, batch, seq, remat,
-                          capture_state=not fast),
+                          capture_state=want_breakdown),
         tag="gpt headline")
     if not math.isfinite(final_loss):
         raise SystemExit(f"final loss is not finite: {final_loss}")
     # profile the HEADLINE step; gpt_op_breakdown releases the retained
     # train state in its finally block (it must not stay live through
     # the later legs)
-    op_breakdown = None if fast else gpt_op_breakdown()
+    op_breakdown = gpt_op_breakdown() if want_breakdown else None
+
+    # telemetry_overhead: the headline step re-run with the in-jit
+    # MetricsState drained to JSONL every step — the A/B that proves the
+    # sync-free instrumentation design costs nothing (acceptance: within
+    # 1% of the bare step; negative = noise in the bare leg's favor).
+    # A full extra bench_gpt run, so fast mode skips it on every backend
+    # (BENCH_TELEMETRY_OVERHEAD=1 forces it — e.g. a CPU smoke run with
+    # BENCH_FAST=1 BENCH_GPT_LAYERS=2 that still wants the A/B).
+    telemetry_overhead = None
+    if not fast or os.environ.get("BENCH_TELEMETRY_OVERHEAD") == "1":
+        try:
+            instr_s, _, _ = _retry_transient(
+                lambda: bench_gpt(iters, batch, seq, remat,
+                                  telemetry_every=1,
+                                  leg="gpt_instrumented"),
+                tag="telemetry overhead leg")
+            overhead_pct = (instr_s / step_s - 1.0) * 100.0
+            telemetry_overhead = {
+                "bare_step_ms": round(step_s * 1e3, 2),
+                "instrumented_step_ms": round(instr_s * 1e3, 2),
+                "overhead_pct": round(overhead_pct, 2),
+                "within_1pct": bool(overhead_pct <= 1.0),
+                "drain_every_n": 1,
+            }
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"telemetry overhead leg failed: {type(e).__name__}: {e}",
+                  file=_sys.stderr)
     tokens_per_sec = batch * seq / step_s
     implied_tflops = flops / step_s / 1e12
     mfu = implied_tflops / peak
@@ -572,7 +715,8 @@ def main() -> None:
         os.environ["APEX_TPU_DISABLE_FLASH"] = "1"
         try:
             xla_step_s, _, _ = _retry_transient(
-                lambda: bench_gpt(iters, batch, seq, "selective"),
+                lambda: bench_gpt(iters, batch, seq, "selective",
+                                  leg="gpt_xla_attention"),
                 tag="xla-attn leg")
         finally:
             del os.environ["APEX_TPU_DISABLE_FLASH"]
@@ -582,7 +726,8 @@ def main() -> None:
             flash_step_s = step_s
         else:
             flash_step_s, _, _ = _retry_transient(
-                lambda: bench_gpt(iters, batch, seq, "selective"),
+                lambda: bench_gpt(iters, batch, seq, "selective",
+                                  leg="gpt_flash_selective"),
                 tag="flash leg")
         vs_xla_attention = xla_step_s / flash_step_s  # >1: flash faster
 
@@ -767,6 +912,7 @@ def main() -> None:
     except Exception:
         pass
 
+    jax.effects_barrier()  # flush in-flight async telemetry drains
     print(json.dumps({
         "metric": "gpt2_345m_1chip_bf16_train_throughput",
         "value": round(tokens_per_sec, 1),
@@ -787,11 +933,14 @@ def main() -> None:
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,
+        "telemetry_overhead": telemetry_overhead,
+        "telemetry_jsonl": telemetry_recorder().path,
         "batch": batch,
         "seq": seq,
         "recompute": remat or None,
         "backend": jax.default_backend(),
     }))
+    telemetry_recorder().close()
 
 
 if __name__ == "__main__":

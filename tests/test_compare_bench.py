@@ -1,0 +1,157 @@
+"""Smoke tests for tools/compare_bench.py — tier-1-safe (pure JSON, no
+jax): per-leg regression detection plus schema-drift protection against
+the real archived bench captures, so a bench.py output change that
+breaks the extractor fails CI here rather than silently in the driver.
+"""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.compare_bench import (  # noqa: E402
+    compare,
+    compare_trajectory,
+    extract_legs,
+    load_bench,
+    main,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _bench(tokens=30000.0, bert=12000.0, gbps=600.0):
+    return {
+        "metric": "gpt2_345m_1chip_bf16_train_throughput",
+        "value": tokens,
+        "unit": "tokens/sec",
+        "true_mfu": 0.33,
+        "bert_large_lamb": {"tokens_per_sec": bert},
+        "packed_optimizer": {"gbps_achieved": gbps, "vs_pytree": 1.4},
+        "telemetry_overhead": {"overhead_pct": 0.3},
+    }
+
+
+def test_extract_legs_orients_lower_is_better():
+    legs = extract_legs(_bench())
+    assert legs["gpt_tokens_per_sec"] == 30000.0
+    # lower-is-better legs are negated so "higher is better" is uniform
+    assert legs["telemetry_overhead_pct"] == -0.3
+
+
+def test_compare_flags_regression_and_improvement():
+    base = _bench()
+    new = _bench(tokens=20000.0, bert=13000.0)  # gpt -33%, bert +8%
+    rep = compare(base, new, threshold=0.05)
+    regressed = {r["leg"] for r in rep["regressions"]}
+    improved = {r["leg"] for r in rep["improvements"]}
+    assert "gpt_tokens_per_sec" in regressed
+    assert "bert_tokens_per_sec" in improved
+    assert "packed_opt_gbps" in rep["unchanged"]
+    # a higher overhead_pct is a REGRESSION even though the number rose,
+    # and the report shows the ORIGINAL signed values, not magnitudes
+    lucky = _bench()
+    lucky["telemetry_overhead"]["overhead_pct"] = -0.5
+    worse_overhead = _bench()
+    worse_overhead["telemetry_overhead"]["overhead_pct"] = 5.0
+    rep2 = compare(lucky, worse_overhead, threshold=0.05)
+    (entry,) = [r for r in rep2["regressions"]
+                if r["leg"] == "telemetry_overhead_pct"]
+    assert entry["base"] == -0.5 and entry["new"] == 5.0
+    assert entry["delta_abs"] == pytest.approx(5.5)
+
+
+def test_overhead_pct_uses_absolute_tolerance():
+    """A near-zero percentage metric must not turn sub-point noise into
+    a regression via the relative threshold (-0.3 -> +0.4 is noise)."""
+    lucky, noisy = _bench(), _bench()
+    lucky["telemetry_overhead"]["overhead_pct"] = -0.3
+    noisy["telemetry_overhead"]["overhead_pct"] = 0.4
+    rep = compare(lucky, noisy, threshold=0.05)
+    assert "telemetry_overhead_pct" in rep["unchanged"]
+
+
+def test_compare_within_threshold_is_unchanged():
+    rep = compare(_bench(tokens=10000.0), _bench(tokens=10300.0),
+                  threshold=0.05)
+    assert not rep["regressions"] and not rep["improvements"]
+    assert "gpt_tokens_per_sec" in rep["unchanged"]
+
+
+def test_compare_reports_schema_drift():
+    base, new = _bench(), _bench()
+    del new["bert_large_lamb"]  # a leg vanishing must be visible
+    rep = compare(base, new)
+    assert "bert_tokens_per_sec" in rep["only_in_base"]
+
+
+def test_load_bench_handles_raw_capture_and_garbage(tmp_path):
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(_bench()))
+    assert load_bench(str(raw))["value"] == 30000.0
+
+    cap = tmp_path / "cap.json"
+    cap.write_text(json.dumps(
+        {"n": 3, "rc": 0, "tail": "noise\n" + json.dumps(_bench()),
+         "parsed": None}))
+    assert load_bench(str(cap))["value"] == 30000.0
+
+    trunc = tmp_path / "trunc.json"
+    trunc.write_text(json.dumps(
+        {"n": 5, "rc": 0, "tail": 'gbps": 1.0}', "parsed": None}))
+    assert load_bench(str(trunc)) is None
+
+
+@pytest.mark.parametrize("name", ["BENCH_r01", "BENCH_r02", "BENCH_r03",
+                                  "BENCH_r04"])
+def test_archived_captures_still_extract(name):
+    """Schema-drift canary: the real driver captures must keep yielding
+    the headline leg (bench.py output format and the extractor evolve
+    together or this fails)."""
+    bench = load_bench(str(REPO / f"{name}.json"))
+    assert bench is not None
+    legs = extract_legs(bench)
+    assert "gpt_tokens_per_sec" in legs
+    assert legs["gpt_tokens_per_sec"] > 0
+
+
+def test_trajectory_over_archived_captures():
+    paths = [str(REPO / f"BENCH_r0{i}.json") for i in (1, 2, 3, 4)]
+    rep = compare_trajectory(paths, threshold=0.05)
+    assert len(rep["steps"]) == 3
+    for step in rep["steps"]:
+        assert "regressions" in step and "only_in_new" in step
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_bench()))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_bench(tokens=31000.0)))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_bench(tokens=9000.0)))
+
+    assert main([str(base), str(good)]) == 0
+    capsys.readouterr()  # drop the first report
+    assert main([str(base), str(bad)]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["regressions"][0]["leg"] == "gpt_tokens_per_sec"
+    # custom threshold: a 10% drop passes at --threshold 0.2
+    mid = tmp_path / "mid.json"
+    mid.write_text(json.dumps(_bench(tokens=27000.0)))
+    assert main([str(base), str(mid), "--threshold", "0.2"]) == 0
+
+
+def test_cli_trajectory_all_unparseable_fails_loudly(tmp_path, capsys):
+    """Schema drift truncating EVERY capture must not exit 0 — an empty
+    comparison is a failure of the gate, not a pass."""
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"t{i}.json"
+        p.write_text(json.dumps({"n": i, "rc": 0, "tail": "}", "parsed": None}))
+        paths.append(str(p))
+    assert main(paths + ["--trajectory"]) == 2
+    report = json.loads(capsys.readouterr().out)
+    assert len(report["skipped_unparseable"]) == 3

@@ -129,6 +129,50 @@ def test_timers_measure_and_log():
     assert "step" in out
 
 
+def test_packed_optimizer_named_scopes_reach_compiled_hlo():
+    """The packed flat-buffer kernels must be attributable in profiler
+    traces: their named scopes have to survive into compiled-op metadata
+    (both the Pallas kernels on TPU and the XLA fallback exercised here
+    carry them — the decorator wraps the whole op)."""
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+    params = {"w": jnp.zeros((512,), jnp.bfloat16),
+              "b": jnp.zeros((256,), jnp.bfloat16)}
+    grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+
+    adam = FusedAdam(lr=1e-3, master_weights=True, packed=True)
+    astate = adam.init(params)
+    txt = jax.jit(lambda g, s, p: adam.step(g, s, p)).lower(
+        grads, astate, params).compile().as_text()
+    assert "apex_tpu.packed_adam" in txt
+
+    lamb = FusedLAMB(lr=1e-3, packed=True)
+    lstate = lamb.init(params)
+    txt = jax.jit(lambda g, s, p: lamb.step(g, s, p)).lower(
+        grads, lstate, params).compile().as_text()
+    # both LAMB stages plus the per-tensor-norm reduction
+    assert "apex_tpu.packed_lamb_stage1" in txt
+    assert "apex_tpu.packed_scale_update" in txt
+    assert "apex_tpu.packed_row_reduce" in txt
+
+
+def test_flash_attention_named_scope_reaches_compiled_hlo():
+    """Flash attention time must be attributable in traces (the r5 op
+    breakdown's 14% 'apex_tpu.flash_attention' bucket depends on it)."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((1, 2, 128, 64))
+    try:
+        txt = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+        ).lower(q, q, q).compile().as_text()
+    except AttributeError as e:  # pallas API gap on old jax (the same
+        import pytest           # gap that fails the seed flash tests)
+
+        pytest.skip(f"flash kernel unavailable on this jax: {e}")
+    assert "apex_tpu.flash_attention" in txt
+
+
 def test_sequence_parallel_linears_compile_to_gather_scatter_pair():
     """Megatron SP's defining collective structure: the column linear
     all-gathers the sequence-scattered input forward (reduce-scatter in

@@ -1,0 +1,191 @@
+"""Diff bench results and flag per-leg regressions.
+
+``bench.py`` prints one JSON object per run; the driver archives them as
+``BENCH_r0N.json`` capture files (``{"n", "cmd", "rc", "tail",
+"parsed"}`` — ``parsed`` is the bench dict, or null when the captured
+tail was truncated, in which case the tail itself is re-parsed here).
+This tool compares two results — or a whole trajectory — leg by leg and
+exits non-zero when any leg regressed beyond the threshold, so CI
+catches both performance regressions and silent bench schema drift
+(a leg disappearing from the output is reported, not ignored).
+
+Usage::
+
+    python tools/compare_bench.py BASE.json NEW.json [--threshold 0.05]
+    python tools/compare_bench.py --trajectory BENCH_r0*.json
+
+Legs are extracted by dotted path; every metric is oriented so HIGHER is
+better (``step_ms``-style values are inverted at extraction).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# (leg name, dotted path into the bench dict, higher_is_better)
+METRICS: Tuple[Tuple[str, str, bool], ...] = (
+    ("gpt_tokens_per_sec", "value", True),
+    ("gpt_true_mfu", "true_mfu", True),
+    ("gpt_vs_xla_attention", "vs_xla_attention", True),
+    ("bert_tokens_per_sec", "bert_large_lamb.tokens_per_sec", True),
+    ("resnet_images_per_sec", "resnet50_o2.images_per_sec", True),
+    ("packed_opt_gbps", "packed_optimizer.gbps_achieved", True),
+    ("packed_opt_vs_pytree", "packed_optimizer.vs_pytree", True),
+    ("fp8_gemm_vs_bf16", "fp8_e4m3_gemm_vs_bf16", True),
+    ("fp8_model_tokens_per_sec", "gpt2_345m_fp8.tokens_per_sec", True),
+    ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
+)
+
+# legs whose expected value is ~0, where a relative threshold would turn
+# sub-point noise into a "regression": compared with an ABSOLUTE
+# tolerance (same units as the metric) instead of a fraction of |base|
+ABS_TOLERANCE = {
+    "telemetry_overhead_pct": 1.0,  # percentage points (the <=1% claim)
+}
+
+
+def _dig(d: dict, path: str):
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def load_bench(path: str) -> Optional[dict]:
+    """Load a bench result: a raw bench dict, or a driver capture whose
+    ``parsed`` (or, failing that, last ``tail`` line) holds it. ``None``
+    when nothing parseable is found (truncated capture)."""
+    with open(path) as f:
+        d = json.load(f)
+    if "metric" in d or "value" in d:
+        return d
+    if isinstance(d.get("parsed"), dict):
+        return d["parsed"]
+    tail = d.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+    return None
+
+
+def extract_legs(bench: dict) -> Dict[str, float]:
+    """Numeric per-leg values, oriented so higher is better."""
+    out: Dict[str, float] = {}
+    for name, path, higher in METRICS:
+        v = _dig(bench, path)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[name] = float(v) if higher else -float(v)
+    return out
+
+
+def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
+    """Leg-by-leg comparison: a leg regresses when it is worse than base
+    by more than ``threshold`` (fractional). Legs present on only one
+    side are listed separately — schema drift must be visible."""
+    a, b = extract_legs(base), extract_legs(new)
+    higher = {name: h for name, _, h in METRICS}
+    regressions: List[dict] = []
+    improvements: List[dict] = []
+    unchanged: List[str] = []
+    for leg in sorted(set(a) & set(b)):
+        va, vb = a[leg], b[leg]
+        # report the ORIGINAL metric values (un-orient the inverted
+        # lower-is-better legs) so e.g. a negative overhead_pct keeps
+        # its sign in the triage output
+        sign = 1.0 if higher[leg] else -1.0
+        entry = {"leg": leg, "base": sign * va, "new": sign * vb}
+        abs_tol = ABS_TOLERANCE.get(leg)
+        if abs_tol is not None:
+            # near-zero metric: absolute change, reported in the
+            # original (un-oriented) units to match base/new
+            delta = vb - va
+            entry["delta_abs"] = round(sign * delta, 4)
+            worse, better = delta < -abs_tol, delta > abs_tol
+        else:
+            # oriented values can be negative; ratio against magnitude
+            # keeps the sign convention
+            if va == 0:
+                delta = (0.0 if vb == 0
+                         else float("inf") * (1 if vb > va else -1))
+            else:
+                delta = (vb - va) / abs(va)
+            entry["delta_pct"] = round(100.0 * delta, 2)
+            worse, better = delta < -threshold, delta > threshold
+        if worse:
+            regressions.append(entry)
+        elif better:
+            improvements.append(entry)
+        else:
+            unchanged.append(leg)
+    return {
+        "threshold_pct": round(100.0 * threshold, 2),
+        "regressions": regressions,
+        "improvements": improvements,
+        "unchanged": unchanged,
+        "only_in_base": sorted(set(a) - set(b)),
+        "only_in_new": sorted(set(b) - set(a)),
+    }
+
+
+def compare_trajectory(paths: List[str], threshold: float = 0.05) -> dict:
+    """Compare consecutive pairs along a trajectory of result files;
+    unparseable captures are reported and skipped."""
+    loaded = []
+    skipped = []
+    for p in paths:
+        bench = load_bench(p)
+        if bench is None:
+            skipped.append(p)
+        else:
+            loaded.append((p, bench))
+    steps = []
+    for (pa, a), (pb, b) in zip(loaded, loaded[1:]):
+        steps.append({"base": pa, "new": pb,
+                      **compare(a, b, threshold=threshold)})
+    return {"steps": steps, "skipped_unparseable": skipped}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="bench result files")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="fractional regression tolerance per leg "
+                         "(default 0.05 = 5%%)")
+    ap.add_argument("--trajectory", action="store_true",
+                    help="compare consecutive pairs of all files instead "
+                         "of exactly two")
+    args = ap.parse_args(argv)
+
+    if args.trajectory or len(args.files) != 2:
+        if len(args.files) < 2:
+            ap.error("need at least two files")
+        report = compare_trajectory(args.files, threshold=args.threshold)
+        if not report["steps"]:
+            # nothing comparable (e.g. every capture truncated): the
+            # gate must fail loudly, not wave the drift through
+            print(json.dumps(report, indent=2))
+            return 2
+        regressed = any(s["regressions"] for s in report["steps"])
+    else:
+        base, new = (load_bench(p) for p in args.files)
+        if base is None or new is None:
+            print(json.dumps({"error": "unparseable bench file"}))
+            return 2
+        report = compare(base, new, threshold=args.threshold)
+        regressed = bool(report["regressions"])
+    print(json.dumps(report, indent=2))
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,134 +2,45 @@
 
 The reference publishes per-kernel timings through nvprof/nsys and the
 Megatron timers (``apex/transformer/pipeline_parallel/_timers.py`` usage
-in the fork's scaling scripts); the TPU analogue is an xplane trace. This
-parses the trace's ``XLA Ops`` device line and aggregates op self-times,
-so ``bench.py`` can publish WHERE a step's milliseconds go (top-10 table)
-instead of a single opaque step time.
+in the fork's scaling scripts); the TPU analogue is an xplane trace. The
+implementation now lives in :mod:`apex_tpu.telemetry.tracing` (so the
+parser unit-tests on canned fixtures and trace sessions are a library
+feature); this module remains the script-facing entry point and keeps
+its historical names.
 
 Usage::
 
     from tools.op_breakdown import profile_step_breakdown
     table = profile_step_breakdown(step_fn, state, n_steps=3)
 
-Returns ``{"device_ms_per_step": float, "ops": [{"op", "category",
-"ms_per_step", "pct"}, ...]}`` or ``None`` when no device plane was
-captured (non-TPU backends).
+Returns ``{"source": "xplane", "device_ms_per_step": float, "ops":
+[{"op", "category", "ms_per_step", "pct"}, ...], "categories": {...}}``
+on TPU. On backends with no device plane (CPU CI) it now returns the
+``Compiled.cost_analysis()`` flops/bytes attribution (``"source":
+"cost_analysis"``) instead of ``None`` — every environment gets a table.
 """
 from __future__ import annotations
 
-import glob
-import os
-import re
-import tempfile
-from collections import defaultdict
-
-
-def _short_op_name(hlo_text: str) -> str:
-    """'%convolution_tanh_fusion.3 = bf16[...] ...' -> 'convolution_tanh_fusion'."""
-    name = hlo_text.split(" = ", 1)[0].strip()
-    name = name.lstrip("%")
-    return re.sub(r"\.\d+$", "", name)
-
-
-_CATEGORIES = (
-    ("flash|attention", "attention-kernel"),
-    ("custom-call", "custom-call"),
-    ("convolution|dot|gemm", "matmul/conv"),
-    ("all-reduce|all-gather|reduce-scatter|collective|permute", "collective"),
-    ("copy|transpose|bitcast|reshape", "data-movement"),
-    ("scatter|gather|dynamic", "gather/scatter"),
-    ("reduce", "reduce"),
-    ("fusion", "fusion(elementwise)"),
+from apex_tpu.telemetry.tracing import (  # noqa: F401
+    aggregate_op_times,
+    breakdown_table,
+    categorize_op,
+    cost_analysis_breakdown,
+    iter_xplane_events,
+    parse_xspace_op_times,
+    profile_step,
+    short_op_name,
+    trace_session,
 )
 
-
-def _category(op: str) -> str:
-    low = op.lower()
-    for pat, cat in _CATEGORIES:
-        if re.search(pat, low):
-            return cat
-    return "other"
-
-
-def parse_xspace_op_times(trace_dir: str):
-    """Aggregate XLA-op durations from every .xplane.pb under trace_dir.
-
-    Returns (total_ps, {op_name: ps}) summed over all captured device
-    planes and steps.
-    """
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:  # tensorflow not present on this image
-        return 0, {}
-
-    per_op: dict = defaultdict(int)
-    total = 0
-    for path in glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    ):
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
-        for plane in xs.planes:
-            if "/device:TPU" not in plane.name:
-                continue
-            for line in plane.lines:
-                if line.name != "XLA Ops":
-                    continue
-                for ev in line.events:
-                    md = plane.event_metadata[ev.metadata_id]
-                    name = _short_op_name(md.name)
-                    # container ops (while/conditional) span their body
-                    # ops, which are ALSO events on this line — counting
-                    # both would double the loop time
-                    if name.startswith(("while", "conditional")):
-                        continue
-                    per_op[name] += ev.duration_ps
-                    total += ev.duration_ps
-    return total, dict(per_op)
+# historical private names (pinned by tests/test_op_breakdown.py)
+_short_op_name = short_op_name
+_category = categorize_op
 
 
 def profile_step_breakdown(step_fn, state, n_steps: int = 3, top: int = 10):
     """Trace ``n_steps`` chained executions of ``step_fn`` and return the
     top-``top`` ops by device self-time (XLA Ops line; ops on that line
-    are leaf HLO instructions, so durations are self-times)."""
-    import jax
-
-    d = tempfile.mkdtemp(prefix="apex_tpu_xprof_")
-    with jax.profiler.trace(d):
-        for _ in range(n_steps):
-            state = step_fn(*state)
-        jax.tree_util.tree_map(
-            lambda x: x.block_until_ready() if hasattr(
-                x, "block_until_ready") else x,
-            state[-1],
-        )
-    total_ps, per_op = parse_xspace_op_times(d)
-    if not total_ps:
-        return None
-    rows = sorted(per_op.items(), key=lambda kv: -kv[1])
-    ops = [
-        {
-            "op": name,
-            "category": _category(name),
-            "ms_per_step": round(ps / 1e9 / n_steps, 3),
-            "pct": round(100.0 * ps / total_ps, 2),
-        }
-        for name, ps in rows[:top]
-    ]
-    by_cat: dict = defaultdict(int)
-    for name, ps in per_op.items():
-        by_cat[_category(name)] += ps
-    categories = {
-        cat: {
-            "ms_per_step": round(ps / 1e9 / n_steps, 3),
-            "pct": round(100.0 * ps / total_ps, 2),
-        }
-        for cat, ps in sorted(by_cat.items(), key=lambda kv: -kv[1])
-    }
-    return {
-        "device_ms_per_step": round(total_ps / 1e9 / n_steps, 3),
-        "ops": ops,
-        "categories": categories,
-    }
+    are leaf HLO instructions, so durations are self-times), falling back
+    to the static cost-analysis attribution off-TPU."""
+    return profile_step(step_fn, state, n_steps=n_steps, top=top)
