@@ -87,16 +87,32 @@ class LossScaler:
         return loss * state.loss_scale.astype(loss.dtype)
 
     def unscale(
-        self, state: LossScaleState, grads: Pytree, out_dtype=None
-    ) -> Tuple[Pytree, LossScaleState]:
+        self, state: LossScaleState, grads: Pytree, out_dtype=None,
+        numerics=None,
+    ):
         """Unscale grads by 1/scale, recording overflow.
 
         Reference ``apex/amp/scaler.py:94-150`` (``unscale`` via
         ``multi_tensor_scale`` with inf screening).
+
+        With ``numerics=`` — a ``(NumericsMonitor, NumericsState)`` pair
+        from ``apex_tpu.telemetry.numerics`` — the per-leaf non-finite
+        flags this sweep already computes (the screening behind
+        ``found_inf``) are folded into the numerics state for overflow
+        PROVENANCE: when the scaler trips, the drained anomaly event
+        names exactly the non-finite leaves, at zero extra sweeps.
+        Returns ``(grads, new_state, new_numerics_state)`` instead of the
+        2-tuple.
         """
         inv = 1.0 / state.loss_scale
-        out, found = multi_tensor_scale(grads, inv, out_dtype=out_dtype)
-        return out, state._replace(found_inf=state.found_inf | found)
+        if numerics is None:
+            out, found = multi_tensor_scale(grads, inv, out_dtype=out_dtype)
+            return out, state._replace(found_inf=state.found_inf | found)
+        monitor, nstate = numerics
+        out, found, leaf_flags = multi_tensor_scale(
+            grads, inv, out_dtype=out_dtype, per_tensor=True)
+        nstate = monitor.observe(nstate, leaf_nonfinite=leaf_flags)
+        return out, state._replace(found_inf=state.found_inf | found), nstate
 
     def unscale_with_stashed(
         self, state: LossScaleState, new_scaled_grads: Pytree, stashed_grads: Pytree
@@ -110,7 +126,8 @@ class LossScaler:
         out, found = multi_tensor_axpby(inv, 1.0, new_scaled_grads, stashed_grads)
         return out, state._replace(found_inf=state.found_inf | found)
 
-    def update_scale(self, state: LossScaleState, metrics=None):
+    def update_scale(self, state: LossScaleState, metrics=None,
+                     numerics=None):
         """End-of-step scale adjustment (``apex/amp/scaler.py:197-216``).
 
         Consumes ``found_inf`` and resets it for the next step. Static mode
@@ -120,18 +137,32 @@ class LossScaler:
         scaler also folds this update into the cumulative telemetry
         counters — ``overflow_skips`` increments when the consumed
         ``found_inf`` skipped the step, ``scale_growths`` when the scale
-        grew — and returns ``(new_state, new_metrics)`` instead of just
-        the state. Pure in-jit arithmetic: no extra host syncs.
+        grew. With ``numerics=`` (an
+        ``apex_tpu.telemetry.numerics.NumericsState``) the consumed flag
+        and the old/new scales feed the anomaly engine (overflow latch,
+        first-bad-step, the edge-triggered scale-collapse rule). Pure
+        in-jit arithmetic either way: no extra host syncs. Returns
+        ``new_state`` alone, or ``(new_state, metrics)``, ``(new_state,
+        numerics)``, ``(new_state, metrics, numerics)`` matching what was
+        passed.
         """
         new_state = self._update_scale(state)
-        if metrics is None:
-            return new_state
-        from ..telemetry.metrics import observe_scale_update
+        out = (new_state,)
+        if metrics is not None:
+            from ..telemetry.metrics import observe_scale_update
 
-        metrics = observe_scale_update(
-            metrics, state.found_inf, state.loss_scale,
-            new_state.loss_scale)
-        return new_state, metrics
+            out += (observe_scale_update(
+                metrics, state.found_inf, state.loss_scale,
+                new_state.loss_scale),)
+        if numerics is not None:
+            from ..telemetry.numerics import (
+                observe_scale_update as numerics_scale_update,
+            )
+
+            out += (numerics_scale_update(
+                numerics, state.found_inf, state.loss_scale,
+                new_state.loss_scale),)
+        return out if len(out) > 1 else new_state
 
     def _update_scale(self, state: LossScaleState) -> LossScaleState:
         if not self.dynamic:

@@ -19,6 +19,7 @@ folds into the additive bias exactly as the reference does.
 """
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Optional
 
@@ -74,25 +75,57 @@ def _drop5(x, what):
 _warned_fully_masked = False
 
 
+def _is_traced(x) -> bool:
+    """True for values that are abstract at this point (inside a trace).
+
+    Deliberately avoids ``isinstance(x, jax.core.Tracer)`` — the
+    ``jax.core`` re-export is semi-private and deprecation-warned in
+    newer JAX. ``jax.core.is_concrete`` is preferred when present;
+    otherwise an ``aval``-based check that tolerates API moves: a value
+    with a non-concrete aval cannot be materialised by ``jax.device_get``.
+    """
+    if not hasattr(x, "aval"):
+        return False  # numpy array / python scalar: concrete
+    core = getattr(jax, "core", None)
+    is_concrete = getattr(core, "is_concrete", None)
+    if is_concrete is not None:
+        try:
+            return not is_concrete(x)
+        except Exception:
+            pass
+    tracer_cls = getattr(core, "Tracer", None)
+    if tracer_cls is not None:
+        return isinstance(x, tracer_cls)
+    try:  # last resort: concrete values materialise, tracers raise
+        jax.device_get(x)
+        return False
+    except Exception:
+        return True
+
+
 def _maybe_warn_fully_masked(key_mask):
     """One-time heads-up for the kv_mask fast path's edge semantics.
 
     The reference's ``(mask - 1) * inf`` bias makes a fully-masked row
     softmax to a uniform average over values; the kernel's ``kv_mask``
     input excludes masked keys exactly, so such a row yields zeros. Rows
-    with >=1 live key agree to kernel tolerance either way. Traced masks
-    (the jit/perf path) warn once unconditionally — the divergence is
-    unknowable at trace time, and one warning per process is cheap.
-    Concrete masks are actually CHECKED, every call until one warns: the
-    check is a host sync, but an eager-mode caller is not on the perf
-    path, and a silent latch would miss the fully-padded batch the
-    warning exists for when it arrives after a clean first batch.
+    with >=1 live key agree to kernel tolerance either way. For traced
+    masks (the jit/perf path) the divergence is unknowable at trace
+    time, so the unconditional trace-time warning is opt-in via
+    ``APEX_TPU_WARN_FULLY_MASKED=1`` (by default it would fire for every
+    jitted caller whether or not a fully-masked row can ever occur —
+    pure noise). Concrete masks are actually CHECKED, every call until
+    one warns: the check is a host sync, but an eager-mode caller is not
+    on the perf path, and a silent latch would miss the fully-padded
+    batch the warning exists for when it arrives after a clean first
+    batch.
     """
     global _warned_fully_masked
     if _warned_fully_masked:
         return
-    if isinstance(key_mask, jax.core.Tracer):
-        fully_masked_possible = True
+    if _is_traced(key_mask):
+        fully_masked_possible = (
+            os.environ.get("APEX_TPU_WARN_FULLY_MASKED", "0") == "1")
     else:
         fully_masked_possible = bool(
             jnp.any(~jnp.any(key_mask != 0, axis=-1))

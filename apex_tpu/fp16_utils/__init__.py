@@ -9,5 +9,9 @@ from .fp16util import (  # noqa: F401
     to_python_float,
     tofp16,
 )
-from .loss_scaler import DynamicLossScaler, LossScaler  # noqa: F401
+from .loss_scaler import (  # noqa: F401
+    DynamicLossScaler,
+    LossScaler,
+    nonfinite_leaves,
+)
 from .fp16_optimizer import FP16_Optimizer  # noqa: F401

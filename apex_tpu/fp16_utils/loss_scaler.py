@@ -16,19 +16,36 @@ import jax.numpy as jnp
 Pytree = Any
 
 
+def _nonfinite_leaf_flags(tree: Pytree):
+    """Per-leaf non-finite flags + names, one host readback for both.
+
+    The legacy API is host-driven anyway (``has_overflow`` syncs), so
+    reading the per-leaf flags instead of the any-reduce costs nothing
+    extra and buys overflow PROVENANCE — the jit-resident analogue is
+    ``apex_tpu.telemetry.numerics``.
+    """
+    paths = [
+        (p, l) for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+        if hasattr(l, "dtype")
+    ]
+    if not paths:
+        return [], []
+    flags = jax.device_get(jnp.stack([
+        ~jnp.all(jnp.isfinite(l.astype(jnp.float32))) for _, l in paths
+    ]))
+    return [jax.tree_util.keystr(p) for p, _ in paths], list(map(bool, flags))
+
+
+def nonfinite_leaves(tree: Pytree) -> list:
+    """Names (tree paths) of the leaves containing inf/NaN. Host-syncing —
+    legacy-API territory; inside jit use ``telemetry.numerics``."""
+    names, flags = _nonfinite_leaf_flags(tree)
+    return [n for n, f in zip(names, flags) if f]
+
+
 def _has_inf_or_nan(tree: Pytree) -> bool:
-    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
-    if not leaves:
-        return False
-    return bool(
-        jax.device_get(
-            jnp.any(
-                jnp.stack(
-                    [~jnp.all(jnp.isfinite(l.astype(jnp.float32))) for l in leaves]
-                )
-            )
-        )
-    )
+    _, flags = _nonfinite_leaf_flags(tree)
+    return any(flags)
 
 
 class LossScaler:
@@ -74,15 +91,23 @@ class DynamicLossScaler(LossScaler):
         init_scale: float = 2 ** 32,
         scale_factor: float = 2.0,
         scale_window: int = 1000,
+        sink=None,
     ):
         super().__init__(init_scale)
         self.cur_iter = 0
         self.last_overflow_iter = -1
         self.scale_factor = scale_factor
         self.scale_window = scale_window
+        # optional telemetry sink (.record(dict)): overflow provenance
+        # events in the same schema as telemetry.numerics anomalies
+        self.sink = sink
+        self.last_overflow_leaves: list = []
 
     def has_overflow(self, grads: Pytree) -> bool:
-        return _has_inf_or_nan(grads)
+        names, flags = _nonfinite_leaf_flags(grads)
+        self.last_overflow_leaves = [
+            n for n, f in zip(names, flags) if f]
+        return any(flags)
 
     @staticmethod
     def _has_inf_or_nan(x) -> bool:
@@ -90,6 +115,14 @@ class DynamicLossScaler(LossScaler):
 
     def update_scale(self, overflow: bool) -> None:
         if overflow:
+            if self.sink is not None:
+                self.sink.record({
+                    "event": "anomaly", "kind": "nonfinite_grads",
+                    "step": self.cur_iter,
+                    "loss_scale": float(self.cur_scale),
+                    "leaves": [{"name": n}
+                               for n in self.last_overflow_leaves],
+                })
             self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
             self.last_overflow_iter = self.cur_iter
         elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:

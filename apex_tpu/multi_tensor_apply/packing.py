@@ -152,6 +152,15 @@ class PackSpec:
     def zeros(self, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros((self.total,), dtype)
 
+    def leaf_names(self) -> Tuple[str, ...]:
+        """Human-readable leaf path strings in flatten order (via
+        ``jax.tree_util.keystr``) — the names overflow-provenance events
+        report (``apex_tpu.telemetry.numerics``)."""
+        dummy = jax.tree_util.tree_unflatten(
+            self.treedef, list(range(self.n_leaves)))
+        paths = jax.tree_util.tree_flatten_with_path(dummy)[0]
+        return tuple(jax.tree_util.keystr(p) for p, _ in paths)
+
     # -- per-row metadata (the chunk->tensor tables) -----------------------
     def row_leaf_ids(self) -> np.ndarray:
         """int32 ``(n_rows,)``: leaf index owning each ROW-sized row;

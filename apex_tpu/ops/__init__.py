@@ -25,6 +25,7 @@ from .packed_optimizer import (  # noqa: F401
     packed_lamb_stage1,
     packed_novograd_apply,
     packed_row_reduce,
+    packed_row_stats,
     packed_scale_update,
     packed_sgd_apply,
 )

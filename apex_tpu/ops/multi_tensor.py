@@ -51,8 +51,9 @@ def has_inf_or_nan(tree: Pytree) -> jax.Array:
 
 
 def multi_tensor_scale(
-    tree: Pytree, scale: jax.Array | float, out_dtype: Optional[jnp.dtype] = None
-) -> Tuple[Pytree, jax.Array]:
+    tree: Pytree, scale: jax.Array | float,
+    out_dtype: Optional[jnp.dtype] = None, per_tensor: bool = False,
+):
     """Scale every leaf by ``scale``; report whether any input was non-finite.
 
     Reference: ``csrc/multi_tensor_scale_kernel.cu`` via
@@ -61,7 +62,11 @@ def multi_tensor_scale(
 
     Returns ``(scaled_tree, found_inf)``. When ``out_dtype`` is given each
     output leaf is cast (the CUDA kernel supported cross-dtype in/out pairs
-    for fp16 model grads -> fp32 master grads).
+    for fp16 model grads -> fp32 master grads). ``per_tensor=True``
+    additionally returns the per-leaf non-finite flags (bool ``(n_leaves,)``
+    in flatten order) the any-reduce consumed — the overflow-provenance
+    input of ``apex_tpu.telemetry.numerics``, free of extra sweeps because
+    the screening already ran per leaf.
     """
     scale = jnp.asarray(scale, dtype=jnp.float32)
 
@@ -72,8 +77,13 @@ def multi_tensor_scale(
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     outs, bads = zip(*[one(l) for l in leaves]) if leaves else ((), ())
-    found_inf = jnp.any(jnp.stack(bads)) if bads else jnp.asarray(False)
-    return jax.tree_util.tree_unflatten(treedef, list(outs)), found_inf
+    leaf_flags = (jnp.stack(bads) if bads
+                  else jnp.zeros((0,), jnp.bool_))
+    found_inf = jnp.any(leaf_flags) if bads else jnp.asarray(False)
+    out_tree = jax.tree_util.tree_unflatten(treedef, list(outs))
+    if per_tensor:
+        return out_tree, found_inf, leaf_flags
+    return out_tree, found_inf
 
 
 def multi_tensor_axpby(

@@ -32,6 +32,11 @@ Kernel inventory (CUDA counterparts in parens):
   (``multi_tensor_novograd.cu``)
 - :func:`packed_row_reduce`     per-row sq-sum / max-abs partials — the
   per-tensor-norm machinery (``multi_tensor_l2norm_kernel.cu``)
+- :func:`packed_row_stats`      per-row sq-sum + max-abs + non-finite
+  count in ONE sweep — the numerics-monitor observation pass
+  (``apex_tpu.telemetry.numerics``); segment-reduce the rows with
+  ``PackSpec.row_leaf_ids()`` for exact per-tensor overflow provenance
+  (rows are leaf-aligned, so a non-finite row names exactly one leaf)
 - :func:`multi_tensor_scale_flat` / :func:`multi_tensor_axpby_flat` /
   :func:`multi_tensor_l2norm_flat`  the ``amp_C`` utility ops over flat
   buffers; these honor the ``chunk_size`` that
@@ -645,6 +650,67 @@ def packed_row_reduce(
     return out.reshape(-1)
 
 
+@jax.named_scope("apex_tpu.packed_row_stats")
+def packed_row_stats(
+    flat: jax.Array,
+    *,
+    inv_scale=1.0,
+    chunk_size: int = DEFAULT_CHUNK,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``(row_sq, row_maxabs, row_nonfinite)`` of ``flat * inv_scale`` in
+    ONE chunked sweep — the numerics-monitor observation pass.
+
+    One read of the buffer yields all three per-ROW partials; a
+    ``segment_sum``/``segment_max`` over ``PackSpec.row_leaf_ids()`` turns
+    them into per-tensor grad norms, max-|g| and non-finite counts (rows
+    are leaf-aligned, so non-finite rows attribute to exactly one leaf —
+    the overflow-provenance contract). ``row_sq``/``row_maxabs`` are RAW
+    reductions: a non-finite element poisons its leaf's norm to nan/inf,
+    which is itself signal; ``row_nonfinite`` is the exact element count.
+    All outputs fp32 ``(rows,)`` covering the input's rows (zero padding
+    added here is finite and reduction-neutral).
+    """
+    flat, n = _pad_to_rows(flat, chunk_size)
+    rows_n = -(-n // ROW)
+
+    def stats(x):
+        return (jnp.sum(x * x, axis=1),
+                jnp.max(jnp.abs(x), axis=1),
+                jnp.sum((~jnp.isfinite(x)).astype(jnp.float32), axis=1))
+
+    if not _kernel_ok(use_kernel, interpret):
+        x = flat.reshape(-1, ROW).astype(jnp.float32)
+        x = x * jnp.asarray(inv_scale, jnp.float32)
+        sq, ma, nf = stats(x)
+        return sq[:rows_n], ma[:rows_n], nf[:rows_n]
+
+    R = flat.shape[0] // ROW
+    B = _block_rows(R, chunk_size)
+
+    def body(s_ref, x_ref, sq_ref, ma_ref, nf_ref):
+        x = x_ref[:].astype(jnp.float32) * s_ref[0, 0]
+        sq, ma, nf = stats(x)
+        sq_ref[0, :] = sq
+        ma_ref[0, :] = ma
+        nf_ref[0, :] = nf
+
+    sq, ma, nf = pl.pallas_call(
+        body,
+        grid=(R // B,),
+        in_specs=[_sspec(), _tspec(B)],
+        out_specs=[_rspec(B), _rspec(B), _rspec(B)],
+        out_shape=[jax.ShapeDtypeStruct((R // B, B), jnp.float32)] * 3,
+        interpret=interpret,
+    )(_scalars(inv_scale), _rows(flat))
+    return (sq.reshape(-1)[:rows_n], ma.reshape(-1)[:rows_n],
+            nf.reshape(-1)[:rows_n])
+
+
+packed_row_stats.accepts_chunk_size = True
+
+
 @jax.named_scope("apex_tpu.multi_tensor_l2norm_flat")
 def multi_tensor_l2norm_flat(
     flat: jax.Array,
@@ -675,40 +741,69 @@ def multi_tensor_scale_flat(
     scale,
     out_dtype=None,
     *,
+    per_row_flags: bool = False,
     chunk_size: int = DEFAULT_CHUNK,
     use_kernel: Optional[bool] = None,
     interpret: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
+):
     """``out = flat * scale`` with non-finite flagging, one chunked sweep
-    (``csrc/multi_tensor_scale_kernel.cu``). Returns ``(out, found_inf)``."""
+    (``csrc/multi_tensor_scale_kernel.cu``). Returns ``(out, found_inf)``.
+
+    ``per_row_flags=True`` widens the flag output from per-chunk to
+    per-ROW and returns ``(out, found_inf, row_bad)`` with ``row_bad`` a
+    bool ``(rows,)`` over the input's rows — same sweep, no extra read.
+    Rows are leaf-aligned under ``PackSpec``, so segment-reducing
+    ``row_bad`` with ``row_leaf_ids()`` names exactly the non-finite
+    leaves (the overflow-provenance path of
+    ``apex_tpu.telemetry.numerics``).
+    """
     out_dtype = jnp.dtype(out_dtype) if out_dtype is not None else flat.dtype
     padded, n = _pad_to_rows(flat, chunk_size)
+    rows_n = -(-n // ROW)
 
     if not _kernel_ok(use_kernel, interpret):
-        out32 = flat.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
-        return out32.astype(out_dtype), ~jnp.all(jnp.isfinite(out32))
+        if not per_row_flags:
+            out32 = (flat.astype(jnp.float32)
+                     * jnp.asarray(scale, jnp.float32))
+            return out32.astype(out_dtype), ~jnp.all(jnp.isfinite(out32))
+        # one multiply sweep over the padded buffer serves both outputs
+        # (padding is trailing zeros, so the slice recovers the result)
+        pad32 = padded.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
+        out = pad32[:n].astype(out_dtype)
+        row_bad = ~jnp.all(
+            jnp.isfinite(pad32).reshape(-1, ROW), axis=1)[:rows_n]
+        return out, jnp.any(row_bad), row_bad
 
     R = padded.shape[0] // ROW
     B = _block_rows(R, chunk_size)
 
     def body(s_ref, x_ref, out_ref, flag_ref):
         out32 = x_ref[:].astype(jnp.float32) * s_ref[0, 0]
-        flag_ref[0, 0] = 1.0 - jnp.all(jnp.isfinite(out32)).astype(
-            jnp.float32)
+        fin = jnp.isfinite(out32)
+        if per_row_flags:
+            flag_ref[0, :] = 1.0 - jnp.all(fin, axis=1).astype(jnp.float32)
+        else:
+            flag_ref[0, 0] = 1.0 - jnp.all(fin).astype(jnp.float32)
         out_ref[:] = out32.astype(out_dtype)
 
     out, flags = pl.pallas_call(
         body,
         grid=(R // B,),
         in_specs=[_sspec(), _tspec(B)],
-        out_specs=[_tspec(B), _flagspec()],
+        out_specs=[_tspec(B),
+                   _rspec(B) if per_row_flags else _flagspec()],
         out_shape=[
             jax.ShapeDtypeStruct((R, ROW), out_dtype),
-            jax.ShapeDtypeStruct((R // B, 1), jnp.float32),
+            jax.ShapeDtypeStruct(
+                (R // B, B if per_row_flags else 1), jnp.float32),
         ],
         interpret=interpret,
     )(_scalars(scale), _rows(padded))
-    return out.reshape(-1)[:n], jnp.any(flags > 0.0)
+    out = out.reshape(-1)[:n]
+    if not per_row_flags:
+        return out, jnp.any(flags > 0.0)
+    row_bad = (flags.reshape(-1) > 0.0)[:rows_n]
+    return out, jnp.any(row_bad), row_bad
 
 
 multi_tensor_scale_flat.accepts_chunk_size = True

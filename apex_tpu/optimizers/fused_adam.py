@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.packed_optimizer import packed_adam_apply
+from ..telemetry import numerics as _numerics
 from ._common import (
     FusedOptimizer,
     Pytree,
@@ -159,6 +160,13 @@ class FusedAdam(FusedOptimizer):
         new_step = state.step + 1
         bc1, bc2 = self._bias_corrections(new_step)
         flat_g = spec.pack(grads, tree_common_dtype(grads))
+        # opt-in activation-watch tap on the packed grad buffer: identity
+        # (no trace difference) unless a numerics.activation_watch is
+        # active; then one extra row-stats sweep names non-finite leaves
+        # through the spec's row-aligned offsets
+        flat_g = _numerics.tap_flat(
+            "apex_tpu.packed_adam/grads", flat_g, spec=spec,
+            inv_scale=inv_scale, interpret=self.packed_interpret)
         p_out, ms, vs, master = packed_adam_apply(
             flat_g,
             state.exp_avg,

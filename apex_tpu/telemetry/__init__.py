@@ -15,10 +15,16 @@ Four parts, designed so instrumentation costs nothing on the hot path:
   fallback off-TPU;
 - :mod:`~apex_tpu.telemetry.pipeline` — pipeline bubble accounting:
   analytic warmup/steady/cooldown timelines per rank and a measured
-  :class:`TickTimeline` fed by the schedules' ``tick_hook``.
+  :class:`TickTimeline` fed by the schedules' ``tick_hook``;
+- :mod:`~apex_tpu.telemetry.numerics` — the numerics health monitor:
+  per-tensor overflow provenance (pytree and packed flat-buffer paths),
+  opt-in activation-watch taps, and an anomaly-rule engine
+  (non-finite grads / grad-norm spike / loss-scale collapse) emitting
+  structured events through the same cond-gated async drain path.
 
 See ``docs/observability.md`` for the end-to-end story.
 """
+from . import numerics  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsState,
     accumulate,
@@ -26,6 +32,12 @@ from .metrics import (  # noqa: F401
     init_metrics,
     observe_scale_update,
     summarize,
+)
+from .numerics import (  # noqa: F401
+    ActivationWatch,
+    NumericsMonitor,
+    NumericsState,
+    activation_watch,
 )
 from .pipeline import (  # noqa: F401
     TickTimeline,
@@ -58,6 +70,8 @@ from .tracing import (  # noqa: F401
 __all__ = [
     "MetricsState", "accumulate", "drain", "init_metrics",
     "observe_scale_update", "summarize",
+    "numerics", "NumericsMonitor", "NumericsState", "ActivationWatch",
+    "activation_watch",
     "TickTimeline", "analytic_bubble_fraction", "bubble_report",
     "classify_phase", "schedule_ticks", "tick_phases",
     "JsonlRecorder", "MultiRecorder", "NullRecorder",

@@ -1,0 +1,618 @@
+"""Numerics health monitor: per-tensor overflow provenance, activation
+watch, anomaly events.
+
+The reference amp tells you *that* grads overflowed — ``LossScaler``
+halves the scale and the step is skipped — but never *which* tensor went
+non-finite, so every long-run instability turns into a bisection hunt.
+This module is the forensic layer on top of the PR-2 telemetry design
+(device-resident state, ``lax.cond``-gated async ``jax.debug.callback``
+drains, recorder sinks with rank-0 gating):
+
+- :class:`NumericsState` — a jit-resident pytree of per-leaf grad
+  statistics (sq-norm, max-|g|, non-finite count) plus the anomaly-engine
+  scalars (grad-norm EWMA, loss scale, first-bad-step). It rides the
+  train-step carry exactly like ``MetricsState`` and is donation-safe.
+- :class:`NumericsMonitor` — the static host-side half: leaf names (tree
+  paths via ``jax.tree_util.keystr`` or ``PackSpec.leaf_names()``),
+  packed-row → leaf mapping, and the anomaly-rule thresholds.
+- **Overflow provenance** — :meth:`NumericsMonitor.observe` folds either
+  a grads pytree (one read sweep: per-leaf sq-norm + max-|g|, with the
+  non-finite indicator free off the max), a packed flat buffer (ONE chunked
+  :func:`~apex_tpu.ops.packed_optimizer.packed_row_stats` sweep +
+  ``segment_sum`` over the row-aligned ``PackSpec.row_leaf_ids()``), or
+  the per-leaf flags the scaler's unscale sweep already produced
+  (``multi_tensor_scale(..., per_tensor=True)`` /
+  ``multi_tensor_scale_flat(..., per_row_flags=True)`` — zero extra
+  sweeps). Rows never straddle leaves, so a non-finite row names exactly
+  one tensor.
+- **Anomaly rules** — evaluated in-jit as booleans, drained through one
+  ``lax.cond``-gated async callback (zero extra host syncs; on healthy
+  steps the cond is not taken and the host does nothing):
+  ``nonfinite_grads`` (with the guilty leaves), ``grad_spike`` (norm vs
+  an EWMA window), ``scale_collapse`` (loss scale crossing below a
+  floor, edge-triggered).
+- **Activation watch** — opt-in :func:`tap` points keyed by the named
+  scopes on the transformer layers and packed kernels; identity (zero
+  cost, no trace difference) unless an :func:`activation_watch` context
+  is active at trace time.
+
+Usage (pytree path)::
+
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import numerics
+
+    rec = telemetry.JsonlRecorder("train.jsonl")      # rank-0 gated
+    mon = numerics.NumericsMonitor(params)            # static names
+    nstate = mon.init()
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, opt_state, nstate, ...):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        nstate = mon.observe(nstate, grads=grads)     # one read sweep
+        params, opt_state = opt.step(grads, opt_state, params)
+        nstate = mon.drain(nstate, rec)               # cond-gated, async
+        return params, opt_state, nstate, loss
+
+With the amp scaler, provenance is free — the unscale sweep already
+screens per leaf::
+
+    grads, sstate, nstate = scaler.unscale(sstate, grads, numerics=(mon, nstate))
+    ...
+    sstate, nstate = scaler.update_scale(sstate, numerics=nstate)
+    nstate = mon.drain(nstate, rec)
+
+Packed path: build the monitor from the optimizer's
+``PackedState.spec`` (or any :class:`PackSpec`) and observe the flat
+gradient buffer — per-leaf attribution comes back through the
+row-aligned offsets::
+
+    mon = numerics.NumericsMonitor(spec=opt_state.spec)
+    nstate = mon.observe(nstate, flat_grads=flat_g, inv_scale=inv)
+
+Render the JSONL stream with ``python tools/health_report.py run.jsonl``
+— per-leaf/per-tap health table with first-bad-step attribution. See
+``docs/observability.md`` ("Numerics & health").
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+# scale_floor default: well above fp32 underflow, far below any healthy
+# dynamic scale — the "loss scale has collapsed, training is dead" line.
+_DEFAULT_SCALE_FLOOR = 2.0 ** -10
+
+
+def leaf_names(tree: Pytree) -> Tuple[str, ...]:
+    """Leaf path strings in flatten order (``jax.tree_util.keystr``)."""
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return tuple(jax.tree_util.keystr(p) for p, _ in paths)
+
+
+def _segment_rows(rows: jax.Array, row_ids, n_leaves: int,
+                  op: str) -> jax.Array:
+    """Per-row partials -> per-leaf via the row-aligned ``row_leaf_ids``
+    table; padding rows fall in segment ``n_leaves`` and are dropped."""
+    ids = jnp.asarray(np.asarray(row_ids)[: rows.shape[0]])
+    if op == "sum":
+        out = jax.ops.segment_sum(rows, ids, num_segments=n_leaves + 1)
+    else:
+        out = jax.ops.segment_max(rows, ids, num_segments=n_leaves + 1)
+    return out[:n_leaves]
+
+
+def _guilty_leaves(names, leaf_nf, sq=None, ma=None):
+    """Host-side list of the non-finite leaves for an anomaly event."""
+    out = []
+    for i in np.nonzero(np.asarray(leaf_nf) > 0)[0]:
+        d = {"name": names[i], "nonfinite": float(leaf_nf[i])}
+        if ma is not None:
+            d["maxabs"] = float(ma[i])
+        if sq is not None:
+            d["norm"] = float(np.sqrt(sq[i]))
+        out.append(d)
+    return out
+
+
+class NumericsState(NamedTuple):
+    """Device-resident numerics accumulators (jit-friendly, donatable).
+
+    Per-leaf arrays are ``(n_leaves,)`` and describe the CURRENT step —
+    :meth:`NumericsMonitor.observe` rewrites them wholesale each call
+    (no cross-step accumulation to drift). Scalars carry the run-level
+    anomaly-engine state.
+    """
+
+    step: jax.Array            # i32, observed steps
+    grad_sq: jax.Array         # f32 (n,) per-leaf grad sq-sums (nan = unknown)
+    grad_maxabs: jax.Array     # f32 (n,) per-leaf max |g| (nan = unknown)
+    grad_nonfinite: jax.Array  # f32 (n,) per-leaf non-finite counts/flags
+    overflow: jax.Array        # bool, this step saw non-finite grads
+    spike: jax.Array           # bool, this step's norm spiked vs the EWMA
+    spike_ratio: jax.Array     # f32, norm / ewma when spike else 0
+    grad_norm: jax.Array       # f32, this step's global grad norm
+    ewma_norm: jax.Array       # f32, EWMA of finite global grad norms
+    ewma_steps: jax.Array      # i32, finite norms folded into the EWMA
+    loss_scale: jax.Array      # f32, last scale from observe_scale_update
+    prev_loss_scale: jax.Array  # f32, the scale before that update
+    first_bad_step: jax.Array  # i32, first overflow step (-1 = never)
+
+
+def observe_scale_update(
+    state: NumericsState, found_inf, old_scale, new_scale
+) -> NumericsState:
+    """Fold one loss-scale update into the numerics state (pure, in-jit).
+
+    Called by :meth:`apex_tpu.amp.LossScaler.update_scale` when given
+    ``numerics=``: the consumed ``found_inf`` marks the step overflowed
+    (first-bad-step latches), and the old/new scales feed the
+    edge-triggered ``scale_collapse`` rule evaluated at drain.
+    """
+    overflow = state.overflow | jnp.asarray(found_inf, jnp.bool_)
+    return state._replace(
+        overflow=overflow,
+        first_bad_step=jnp.where(
+            (state.first_bad_step < 0) & overflow,
+            state.step, state.first_bad_step),
+        prev_loss_scale=jnp.asarray(old_scale, jnp.float32),
+        loss_scale=jnp.asarray(new_scale, jnp.float32),
+    )
+
+
+class NumericsMonitor:
+    """Static half of the numerics monitor: names, mappings, thresholds.
+
+    Build from a params/grads template pytree (leaf names from tree
+    paths) or from a :class:`~apex_tpu.multi_tensor_apply.packing.PackSpec`
+    (``spec=`` — names AND the row→leaf table for packed flat buffers).
+
+    Anomaly rules (all evaluated in-jit, emitted by :meth:`drain`):
+
+    - ``nonfinite_grads`` — any per-leaf non-finite count > 0 (or a
+      folded scaler ``found_inf``); the event names the guilty leaves.
+    - ``grad_spike`` — finite global grad norm > ``spike_factor`` × the
+      EWMA of previous finite norms, after ``spike_warmup`` finite steps.
+    - ``scale_collapse`` — loss scale crossed below ``scale_floor``
+      (edge-triggered on the crossing, not re-emitted while low).
+    """
+
+    def __init__(
+        self,
+        template: Optional[Pytree] = None,
+        *,
+        spec=None,
+        ewma_decay: float = 0.98,
+        spike_factor: float = 10.0,
+        spike_warmup: int = 20,
+        scale_floor: float = _DEFAULT_SCALE_FLOOR,
+        tag: Optional[str] = None,
+    ):
+        # tolerate NumericsMonitor(pack_spec) — a spec is not a pytree of
+        # arrays, so passing it positionally is an easy mistake to honor
+        from ..multi_tensor_apply.packing import PackSpec
+
+        if isinstance(template, PackSpec) and spec is None:
+            template, spec = None, template
+        if (template is None) == (spec is None):
+            raise ValueError(
+                "pass exactly one of a params/grads template pytree or "
+                "spec= (a PackSpec)")
+        if spec is not None:
+            self.names: Tuple[str, ...] = spec.leaf_names()
+            self._row_ids = np.asarray(spec.row_leaf_ids())
+            self._chunk_size = spec.chunk_size
+        else:
+            self.names = leaf_names(template)
+            self._row_ids = None
+            self._chunk_size = None
+        self.n_leaves = len(self.names)
+        self.ewma_decay = float(ewma_decay)
+        self.spike_factor = float(spike_factor)
+        self.spike_warmup = int(spike_warmup)
+        self.scale_floor = float(scale_floor)
+        self.tag = tag
+
+    # -- state -------------------------------------------------------------
+    def init(self) -> NumericsState:
+        n = self.n_leaves
+        # one fresh array per field (the donation contract — see
+        # telemetry.metrics.init_metrics)
+        f = lambda: jnp.float32(0.0)  # noqa: E731
+        i = lambda: jnp.int32(0)  # noqa: E731
+        return NumericsState(
+            step=i(),
+            grad_sq=jnp.full((n,), jnp.nan, jnp.float32),
+            grad_maxabs=jnp.full((n,), jnp.nan, jnp.float32),
+            grad_nonfinite=jnp.zeros((n,), jnp.float32),
+            overflow=jnp.asarray(False),
+            spike=jnp.asarray(False),
+            spike_ratio=f(),
+            grad_norm=f(),
+            ewma_norm=f(),
+            ewma_steps=i(),
+            loss_scale=f(),
+            prev_loss_scale=f(),
+            first_bad_step=jnp.int32(-1),
+        )
+
+    # -- observation (pure, in-jit) ----------------------------------------
+    def observe(
+        self,
+        state: NumericsState,
+        *,
+        grads: Optional[Pytree] = None,
+        flat_grads: Optional[jax.Array] = None,
+        leaf_nonfinite: Optional[jax.Array] = None,
+        row_nonfinite: Optional[jax.Array] = None,
+        inv_scale=1.0,
+        exact_counts: bool = False,
+        interpret: bool = False,
+    ) -> NumericsState:
+        """Begin this step's numerics window from exactly one source.
+
+        - ``grads=`` (pytree): per-leaf sq-sum and max-|g| — two
+          reductions over one read of each leaf; the per-leaf non-finite
+          INDICATOR falls out of max-|g| for free (a non-finite element
+          makes the max nan/inf), so the default healthy-step cost is
+          the two reductions only. ``exact_counts=True`` adds a third
+          reduction for exact per-leaf non-finite element counts
+          (forensic runs; the packed path below gets exact counts at no
+          extra cost).
+        - ``flat_grads=`` (packed 1-D buffer; monitor must be built from
+          the matching ``spec=``): one chunked
+          :func:`~apex_tpu.ops.packed_optimizer.packed_row_stats` sweep,
+          segment-reduced to per-leaf stats through the row-aligned
+          offsets — exact counts included. ``inv_scale`` pre-unscales
+          (loss-scaled grads).
+        - ``leaf_nonfinite=`` (bool/int ``(n_leaves,)``) or
+          ``row_nonfinite=`` (bool ``(rows,)``): provenance-only refresh
+          from flags an existing sweep already produced (the scaler's
+          unscale) — norms stay unknown (nan), zero extra reads.
+        """
+        srcs = [s is not None
+                for s in (grads, flat_grads, leaf_nonfinite, row_nonfinite)]
+        if sum(srcs) != 1:
+            raise ValueError(
+                "observe() takes exactly one of grads=, flat_grads=, "
+                "leaf_nonfinite=, row_nonfinite=")
+        n = self.n_leaves
+        if grads is not None:
+            leaves = jax.tree_util.tree_leaves(grads)
+            if len(leaves) != n:
+                raise ValueError(
+                    f"grads tree has {len(leaves)} leaves, monitor was "
+                    f"built over {n}")
+            static_unit = (isinstance(inv_scale, (int, float))
+                           and float(inv_scale) == 1.0)
+            inv = jnp.asarray(inv_scale, jnp.float32)
+            sqs, mas, nfs = [], [], []
+            with jax.named_scope("apex_tpu.numerics_observe"):
+                for leaf in leaves:
+                    x = leaf.astype(jnp.float32)
+                    if not static_unit:
+                        x = x * inv
+                    sqs.append(jnp.sum(x * x))
+                    mas.append(jnp.max(jnp.abs(x)))
+                    if exact_counts:
+                        nfs.append(jnp.sum(
+                            (~jnp.isfinite(x)).astype(jnp.float32)))
+            sq, ma = jnp.stack(sqs), jnp.stack(mas)
+            # a non-finite element poisons the leaf's max to nan/inf, so
+            # the indicator is free; |g| cannot itself overflow f32
+            # (inputs are finite f32/bf16 and |.| does not grow)
+            nf = (jnp.stack(nfs) if exact_counts
+                  else (~jnp.isfinite(ma)).astype(jnp.float32))
+        elif flat_grads is not None:
+            sq, ma, nf = self._segment_stats(
+                flat_grads, inv_scale, interpret)
+        else:
+            if leaf_nonfinite is None:
+                leaf_nonfinite = self._rows_to_leaves(
+                    jnp.asarray(row_nonfinite, jnp.float32), "sum")
+            nf = jnp.asarray(leaf_nonfinite).astype(jnp.float32)
+            if nf.shape != (n,):
+                raise ValueError(
+                    f"leaf flags shape {nf.shape} != ({n},)")
+            sq = ma = jnp.full((n,), jnp.nan, jnp.float32)
+
+        overflow = jnp.any(nf > 0)
+        norm = jnp.sqrt(jnp.sum(sq))
+        step = state.step + 1
+        # spike: judged against the EWMA of PREVIOUS finite norms, then
+        # the current finite norm is folded in
+        finite = jnp.isfinite(norm) & ~overflow
+        warmed = state.ewma_steps >= self.spike_warmup
+        ratio = norm / jnp.maximum(state.ewma_norm, 1e-30)
+        spike = finite & warmed & (ratio > self.spike_factor)
+        d = jnp.float32(self.ewma_decay)
+        new_ewma = jnp.where(
+            finite,
+            jnp.where(state.ewma_steps == 0, norm,
+                      d * state.ewma_norm + (1.0 - d) * norm),
+            state.ewma_norm)
+        return state._replace(
+            step=step,
+            grad_sq=sq,
+            grad_maxabs=ma,
+            grad_nonfinite=nf,
+            overflow=overflow,
+            spike=spike,
+            spike_ratio=jnp.where(spike, ratio, 0.0),
+            grad_norm=norm,
+            ewma_norm=new_ewma,
+            ewma_steps=state.ewma_steps + finite.astype(jnp.int32),
+            first_bad_step=jnp.where(
+                (state.first_bad_step < 0) & overflow, step,
+                state.first_bad_step),
+        )
+
+    def _require_spec(self):
+        if self._row_ids is None:
+            raise ValueError(
+                "packed observation needs a monitor built from the "
+                "optimizer's PackSpec: NumericsMonitor(spec=state.spec)")
+
+    def _rows_to_leaves(self, rows: jax.Array, op: str) -> jax.Array:
+        self._require_spec()
+        return _segment_rows(rows, self._row_ids, self.n_leaves, op)
+
+    def _segment_stats(self, flat, inv_scale, interpret):
+        from ..ops.packed_optimizer import packed_row_stats
+
+        self._require_spec()
+        if flat.ndim != 1:
+            raise ValueError(f"flat_grads must be 1-D, got {flat.shape}")
+        row_sq, row_ma, row_nf = packed_row_stats(
+            flat, inv_scale=inv_scale,
+            chunk_size=self._chunk_size, interpret=interpret)
+        return (self._rows_to_leaves(row_sq, "sum"),
+                self._rows_to_leaves(row_ma, "max"),
+                self._rows_to_leaves(row_nf, "sum"))
+
+    # -- drain (events, cond-gated async) ----------------------------------
+    def drain(
+        self,
+        state: NumericsState,
+        sink,
+        *,
+        tag: Optional[str] = None,
+        health_every: int = 0,
+    ) -> NumericsState:
+        """Emit anomaly events (and optional periodic health records).
+
+        Call once per step after the observations. In-jit: a single
+        ``lax.cond`` over ``overflow | spike | scale_collapse`` wraps an
+        async ``jax.debug.callback`` — healthy steps take the empty
+        branch and cost no host work at all (the PR-2 drain contract;
+        ``jax.effects_barrier()`` at shutdown flushes stragglers).
+        ``health_every=N`` additionally emits a per-leaf health table
+        every N steps through its own cond (for the
+        ``tools/health_report.py`` per-layer table); 0 disables it.
+
+        ``sink`` is a recorder (``.record(dict)``) or bare callable; rank
+        gating happens at the sink (``only_logging_process``), so the
+        traced program is identical on every rank.
+        """
+        record = sink.record if hasattr(sink, "record") else sink
+        if not callable(record):
+            raise TypeError(
+                f"sink must expose .record(dict) or be callable, got "
+                f"{sink!r}")
+        names = self.names
+        tag = self.tag if tag is None else tag
+        floor = jnp.float32(self.scale_floor)
+        collapse = ((state.loss_scale > 0)
+                    & (state.loss_scale < floor)
+                    & (state.prev_loss_scale >= floor))
+
+        def _emit(step, nf, sq, ma, overflow, spike, ratio, norm, ewma,
+                  scale, prev_scale, clps, first_bad):
+            base = {"step": int(step), "t_wall": time.time()}
+            if tag is not None:
+                base["tag"] = tag
+            if bool(overflow):
+                guilty = _guilty_leaves(names, nf, sq=sq, ma=ma)
+                record({**base, "event": "anomaly",
+                        "kind": "nonfinite_grads",
+                        "leaves": guilty,
+                        "loss_scale": float(scale),
+                        "first_bad_step": int(first_bad)})
+            if bool(spike):
+                record({**base, "event": "anomaly", "kind": "grad_spike",
+                        "grad_norm": float(norm), "ewma_norm": float(ewma),
+                        "ratio": float(ratio)})
+            if bool(clps):
+                record({**base, "event": "anomaly",
+                        "kind": "scale_collapse",
+                        "loss_scale": float(scale),
+                        "prev_loss_scale": float(prev_scale),
+                        "floor": self.scale_floor})
+
+        def _fire():
+            jax.debug.callback(
+                _emit, state.step, state.grad_nonfinite, state.grad_sq,
+                state.grad_maxabs, state.overflow, state.spike,
+                state.spike_ratio, state.grad_norm, state.ewma_norm,
+                state.loss_scale, state.prev_loss_scale, collapse,
+                state.first_bad_step)
+
+        any_event = state.overflow | state.spike | collapse
+        jax.lax.cond(any_event, _fire, lambda: None)
+
+        if health_every:
+            def _emit_health(step, sq, ma, nf, norm, ewma, scale,
+                             first_bad):
+                rec = {"event": "numerics_health", "step": int(step),
+                       "grad_norm": float(norm),
+                       "ewma_norm": float(ewma),
+                       "loss_scale": float(scale),
+                       "first_bad_step": int(first_bad),
+                       "t_wall": time.time(),
+                       "leaves": {
+                           names[i]: {
+                               "norm": float(np.sqrt(sq[i])),
+                               "maxabs": float(ma[i]),
+                               "nonfinite": float(nf[i]),
+                           } for i in range(len(names))}}
+                if tag is not None:
+                    rec["tag"] = tag
+                record(rec)
+
+            def _fire_health():
+                jax.debug.callback(
+                    _emit_health, state.step, state.grad_sq,
+                    state.grad_maxabs, state.grad_nonfinite,
+                    state.grad_norm, state.ewma_norm, state.loss_scale,
+                    state.first_bad_step)
+
+            jax.lax.cond(
+                (state.step > 0) & (state.step % health_every == 0),
+                _fire_health, lambda: None)
+        return state
+
+
+# ---------------------------------------------------------------------------
+# activation watch: opt-in taps keyed by named scopes
+# ---------------------------------------------------------------------------
+
+_ACTIVE_WATCH: Optional["ActivationWatch"] = None
+
+
+class ActivationWatch:
+    """Config + sink of an active :func:`activation_watch` context."""
+
+    def __init__(self, sink, *, only_nonfinite: bool = False,
+                 tag: Optional[str] = None):
+        record = sink.record if hasattr(sink, "record") else sink
+        if not callable(record):
+            raise TypeError(
+                f"sink must expose .record(dict) or be callable, got "
+                f"{sink!r}")
+        self._record = record
+        self.only_nonfinite = bool(only_nonfinite)
+        self.tag = tag
+
+    def _emit(self, name, layer, maxabs, nonfinite, norm, extra=None):
+        rec = {"event": "activation", "name": str(name),
+               "maxabs": float(maxabs), "nonfinite": float(nonfinite),
+               "norm": float(norm),
+               "t_wall": time.time()}
+        layer = int(layer)
+        if layer >= 0:
+            rec["layer"] = layer
+        if self.tag is not None:
+            rec["tag"] = self.tag
+        if extra:
+            rec.update(extra)
+        self._record(rec)
+
+
+@contextlib.contextmanager
+def activation_watch(sink, *, only_nonfinite: bool = False,
+                     tag: Optional[str] = None):
+    """Enable the :func:`tap` points for code traced inside this context.
+
+    The gate is TRACE-time: a step jitted while no watch is active
+    contains no taps (and a cached executable keeps whatever it was
+    traced with — enable the watch before the first trace, or jit a
+    fresh step). ``only_nonfinite=True`` gates each tap's emission behind
+    a ``lax.cond`` on its non-finite count, so healthy activations cost
+    device arithmetic only. Taps ride ``jax.debug.callback`` — the same
+    forward-only restriction as the pipeline tick hooks applies (current
+    jax drops debug callbacks in scans differentiated *through*; see
+    ``docs/observability.md``).
+    """
+    global _ACTIVE_WATCH
+    prev = _ACTIVE_WATCH
+    _ACTIVE_WATCH = ActivationWatch(
+        sink, only_nonfinite=only_nonfinite, tag=tag)
+    try:
+        yield _ACTIVE_WATCH
+    finally:
+        _ACTIVE_WATCH = prev
+
+
+def watching() -> bool:
+    """True when an :func:`activation_watch` context is active."""
+    return _ACTIVE_WATCH is not None
+
+
+def tap(name: str, x: jax.Array, *, layer=None) -> jax.Array:
+    """Activation-watch tap: identity unless a watch is active at trace
+    time. ``name`` should match the enclosing named scope (the tap points
+    in the transformer layers use ``apex_tpu.transformer_layer/attn`` and
+    ``.../mlp``; packed kernels ``apex_tpu.packed_adam/grads``). ``layer``
+    may be a traced scalar (e.g. the scanned layer number)."""
+    w = _ACTIVE_WATCH
+    if w is None:
+        return x
+    with jax.named_scope(f"apex_tpu.numerics_tap.{name.split('/')[-1]}"):
+        x32 = x.astype(jnp.float32)
+        maxabs = jnp.max(jnp.abs(x32))
+        nonfinite = jnp.sum((~jnp.isfinite(x32)).astype(jnp.float32))
+        norm = jnp.sqrt(jnp.sum(x32 * x32))
+        layer_v = jnp.asarray(-1 if layer is None else layer, jnp.int32)
+
+        def _fire():
+            jax.debug.callback(
+                w._emit, name, layer_v, maxabs, nonfinite, norm)
+
+        if w.only_nonfinite:
+            jax.lax.cond(nonfinite > 0, _fire, lambda: None)
+        else:
+            _fire()
+    return x
+
+
+def tap_flat(name: str, flat: jax.Array, *, spec=None,
+             inv_scale=1.0, interpret: bool = False) -> jax.Array:
+    """Flat-buffer tap for the packed kernels: identity unless a watch is
+    active. With ``spec`` (the buffer's :class:`PackSpec`) a non-finite
+    buffer names its guilty leaves through the row-aligned offsets; the
+    whole observation is one chunked sweep."""
+    w = _ACTIVE_WATCH
+    if w is None:
+        return flat
+    from ..multi_tensor_apply.packing import DEFAULT_CHUNK
+    from ..ops.packed_optimizer import packed_row_stats
+
+    with jax.named_scope(f"apex_tpu.numerics_tap.{name.split('/')[-1]}"):
+        row_sq, row_ma, row_nf = packed_row_stats(
+            flat, inv_scale=inv_scale,
+            chunk_size=(spec.chunk_size if spec is not None
+                        else DEFAULT_CHUNK),
+            interpret=interpret)
+        maxabs = jnp.max(row_ma)
+        nonfinite = jnp.sum(row_nf)
+        norm = jnp.sqrt(jnp.sum(row_sq))
+        if spec is not None:
+            names = spec.leaf_names()
+            leaf_nf = _segment_rows(
+                row_nf, spec.row_leaf_ids(), len(names), "sum")
+
+            def _emit(maxabs, nonfinite, norm, leaf_nf):
+                guilty = _guilty_leaves(names, leaf_nf)
+                w._emit(name, -1, maxabs, nonfinite, norm,
+                        extra={"leaves": guilty} if guilty else None)
+
+            def _fire():
+                jax.debug.callback(_emit, maxabs, nonfinite, norm,
+                                   leaf_nf)
+        else:
+            def _fire():
+                jax.debug.callback(
+                    w._emit, name, jnp.int32(-1), maxabs, nonfinite,
+                    norm)
+
+        if w.only_nonfinite:
+            jax.lax.cond(nonfinite > 0, _fire, lambda: None)
+        else:
+            _fire()
+    return flat

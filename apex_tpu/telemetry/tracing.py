@@ -43,21 +43,64 @@ def short_op_name(hlo_text: str) -> str:
 _CATEGORIES = (
     ("flash|attention", "attention-kernel"),
     ("custom-call", "custom-call"),
-    ("convolution|dot|gemm", "matmul/conv"),
-    ("all-reduce|all-gather|reduce-scatter|collective|permute", "collective"),
-    ("copy|transpose|bitcast|reshape", "data-movement"),
+    ("convolution|dot|gemm|matmul|einsum", "matmul/conv"),
+    ("all-reduce|all-gather|reduce-scatter|collective|permute|all-to-all",
+     "collective"),
+    ("copy|transpose|bitcast|reshape|data formatting", "data-movement"),
     ("scatter|gather|dynamic", "gather/scatter"),
     ("reduce", "reduce"),
-    ("fusion", "fusion(elementwise)"),
+    ("fusion|elementwise", "fusion(elementwise)"),
 )
 
 # container ops (while/conditional) span their body ops, which are ALSO
 # events on the XLA Ops line — counting both would double the loop time
 _CONTAINER_PREFIXES = ("while", "conditional")
 
+# fusion names with no semantic content: XLA's generic auto-named
+# fusions. "convolution_tanh_fusion" carries its ops in the name;
+# "fusion"/"fused_computation" carry nothing — without an hlo_category
+# hint they must NOT be claimed as elementwise (the round-5 table put
+# 42.7% of the GPT step into "fusion(elementwise)" this way while the
+# dense GEMMs were hiding inside those generic fusions; with MXU ops at
+# the claimed 32% share, the measured true-MFU 0.533 would have been
+# arithmetically impossible).
+_GENERIC_FUSION = re.compile(r"^(loop_|input_|output_)?"
+                             r"(fusion|fused_computation)$")
 
-def categorize_op(op: str) -> str:
+
+def categorize_op(op: str, hlo_category: Optional[str] = None,
+                  raw: Optional[str] = None) -> str:
+    """Category of one op, most-reliable signal first.
+
+    1. An attention-kernel NAME (``apex_tpu_flash_*`` etc.): our named
+       custom-call kernels keep their identity — the profiler's stat for
+       them is just the generic "custom-call".
+    2. ``hlo_category`` — the profiler's own per-op category stat from
+       the xplane (XLA derives it from the fused computation's root op,
+       e.g. ``"convolution fusion"``); authoritative when present.
+    3. The op NAME, when it carries semantic content
+       (``convolution_tanh_fusion`` -> matmul/conv).
+    4. For a generic ``fusion.N``, the callee name inside the raw HLO
+       text (``calls=%convolution_fusion.3``) when available.
+    5. A generic fusion with no signal is reported honestly as
+       ``fusion(unattributed)`` — never silently booked as elementwise.
+    """
+    if re.search(_CATEGORIES[0][0], op.lower()):
+        return _CATEGORIES[0][1]
+    if hlo_category:
+        low = hlo_category.lower()
+        for pat, cat in _CATEGORIES:
+            if re.search(pat, low):
+                return cat
     low = op.lower()
+    if _GENERIC_FUSION.match(low):
+        if raw:
+            m = re.search(r"calls=%?([\w.-]+)", raw)
+            if m:
+                callee = re.sub(r"\.\d+$", "", m.group(1))
+                if not _GENERIC_FUSION.match(callee.lower()):
+                    return categorize_op(callee)
+        return "fusion(unattributed)"
     for pat, cat in _CATEGORIES:
         if re.search(pat, low):
             return cat
@@ -65,28 +108,51 @@ def categorize_op(op: str) -> str:
 
 
 def aggregate_op_times(
-    events: Iterable[Tuple[str, int]],
-) -> Tuple[int, Dict[str, int]]:
-    """Fold raw ``(hlo_op_text, duration_ps)`` events into
-    ``(total_ps, {short_op_name: ps})``, dropping container ops.
+    events: Iterable[Tuple],
+) -> Tuple[int, Dict[Tuple[str, str], int]]:
+    """Fold raw xplane events into ``(total_ps, per_op)`` with
+    ``per_op`` keyed ``(short_op_name, category)``, dropping container
+    ops.
+
+    Events are ``(hlo_op_text, duration_ps)`` or ``(hlo_op_text,
+    duration_ps, hlo_category)`` — the third element is the profiler's
+    per-op category stat, which disambiguates XLA's generic auto-named
+    fusions (every ``%fusion.N`` shares one stripped name, but a
+    convolution fusion and a loop fusion must NOT share one category —
+    the round-5 misattribution). Keying by (name, category) keeps them
+    separate through the merge.
 
     This is the parsing core of the xplane breakdown, taking already
     decoded events so it is unit-testable on a canned fixture (no
     tensorflow protobuf needed).
     """
-    per_op: Dict[str, int] = defaultdict(int)
+    per_op: Dict[Tuple[str, str], int] = defaultdict(int)
     total = 0
-    for raw, ps in events:
+    for item in events:
+        raw, ps = item[0], int(item[1])
+        hint = item[2] if len(item) > 2 else None
         name = short_op_name(raw)
         if name.startswith(_CONTAINER_PREFIXES):
             continue
-        per_op[name] += int(ps)
-        total += int(ps)
+        per_op[(name, categorize_op(name, hint, raw))] += ps
+        total += ps
     return total, dict(per_op)
 
 
-def breakdown_table(total_ps: int, per_op: Dict[str, int],
-                    n_steps: int = 1, top: int = 10) -> Optional[dict]:
+def _normalize_per_op(per_op) -> Dict[Tuple[str, str], int]:
+    """Accept both the (name, category)-keyed dict and the legacy
+    name-keyed dict (pre-fix captures, e.g. archived BENCH_r0* parsing)."""
+    out: Dict[Tuple[str, str], int] = defaultdict(int)
+    for k, ps in per_op.items():
+        if isinstance(k, tuple):
+            out[k] += int(ps)
+        else:
+            out[(k, categorize_op(k))] += int(ps)
+    return dict(out)
+
+
+def breakdown_table(total_ps: int, per_op, n_steps: int = 1,
+                    top: int = 10) -> Optional[dict]:
     """The published table: top-``top`` ops + per-category totals.
 
     Ops on the device ``XLA Ops`` line are leaf HLO instructions, so
@@ -94,19 +160,20 @@ def breakdown_table(total_ps: int, per_op: Dict[str, int],
     """
     if not total_ps:
         return None
-    rows = sorted(per_op.items(), key=lambda kv: -kv[1])
+    norm = _normalize_per_op(per_op)
+    rows = sorted(norm.items(), key=lambda kv: -kv[1])
     ops = [
         {
             "op": name,
-            "category": categorize_op(name),
+            "category": cat,
             "ms_per_step": round(ps / 1e9 / n_steps, 3),
             "pct": round(100.0 * ps / total_ps, 2),
         }
-        for name, ps in rows[:top]
+        for (name, cat), ps in rows[:top]
     ]
     by_cat: Dict[str, int] = defaultdict(int)
-    for name, ps in per_op.items():
-        by_cat[categorize_op(name)] += ps
+    for (name, cat), ps in norm.items():
+        by_cat[cat] += ps
     categories = {
         cat: {
             "ms_per_step": round(ps / 1e9 / n_steps, 3),
@@ -126,10 +193,35 @@ def breakdown_table(total_ps: int, per_op: Dict[str, int],
 # xplane extraction (needs the tensorflow protobuf; TPU images have it)
 # ---------------------------------------------------------------------------
 
+def _stat_value(plane, st):
+    """String value of one XStat, following ref_value indirection."""
+    if st.str_value:
+        return st.str_value
+    if st.ref_value and st.ref_value in plane.stat_metadata:
+        return plane.stat_metadata[st.ref_value].name
+    return ""
+
+
+def _event_hlo_category(plane, ev, md) -> Optional[str]:
+    """The profiler's per-op category stat (``hlo_category``), from the
+    event's stats or the event-metadata's constant stats. This is XLA's
+    own attribution (derived from the fused computation's root op), so a
+    generic ``%fusion.N`` whose root is a convolution reports
+    "convolution fusion" — the signal the breakdown's categories key on.
+    """
+    for stats in (ev.stats, md.stats):
+        for st in stats:
+            smd = plane.stat_metadata.get(st.metadata_id)
+            if smd is not None and smd.name == "hlo_category":
+                return _stat_value(plane, st) or None
+    return None
+
+
 def iter_xplane_events(trace_dir: str):
-    """Yield ``(raw_op_name, duration_ps)`` for every event on a device
-    plane's ``XLA Ops`` line under ``trace_dir``. Empty iterator when the
-    tensorflow protobuf is unavailable or nothing was captured."""
+    """Yield ``(raw_op_name, duration_ps, hlo_category_or_None)`` for
+    every event on a device plane's ``XLA Ops`` line under ``trace_dir``.
+    Empty iterator when the tensorflow protobuf is unavailable or nothing
+    was captured."""
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
     except Exception:  # tensorflow not present on this image
@@ -148,13 +240,14 @@ def iter_xplane_events(trace_dir: str):
                     continue
                 for ev in line.events:
                     md = plane.event_metadata[ev.metadata_id]
-                    yield md.name, ev.duration_ps
+                    yield (md.name, ev.duration_ps,
+                           _event_hlo_category(plane, ev, md))
 
 
-def parse_xspace_op_times(trace_dir: str) -> Tuple[int, Dict[str, int]]:
+def parse_xspace_op_times(trace_dir: str):
     """Aggregate XLA-op self-times from every .xplane.pb under
-    ``trace_dir``: ``(total_ps, {op_name: ps})`` summed over all captured
-    device planes and steps."""
+    ``trace_dir``: ``(total_ps, {(op_name, category): ps})`` summed over
+    all captured device planes and steps."""
     return aggregate_op_times(iter_xplane_events(trace_dir))
 
 

@@ -51,11 +51,18 @@ class GradScaler(LossScaler):
                 continue  # axis not bound in this region
         return f > 0
 
-    def unscale(self, state: LossScaleState, grads, out_dtype=None):
-        grads, new_state = super().unscale(state, grads, out_dtype)
-        return grads, new_state._replace(
+    def unscale(self, state: LossScaleState, grads, out_dtype=None,
+                numerics=None):
+        out = super().unscale(state, grads, out_dtype, numerics=numerics)
+        grads, new_state = out[0], out[1]
+        new_state = new_state._replace(
             found_inf=self._allreduce_found_inf(new_state.found_inf)
         )
+        # numerics provenance stays per-rank (each rank's state names ITS
+        # non-finite leaves); the sink's rank-0 gating decides who writes
+        if numerics is not None:
+            return grads, new_state, out[2]
+        return grads, new_state
 
     def unscale_with_stashed(self, state, new_scaled_grads, stashed_grads):
         grads, new_state = super().unscale_with_stashed(
@@ -65,8 +72,9 @@ class GradScaler(LossScaler):
             found_inf=self._allreduce_found_inf(new_state.found_inf)
         )
 
-    def update_scale(self, state: LossScaleState, metrics=None):
+    def update_scale(self, state: LossScaleState, metrics=None,
+                     numerics=None):
         synced = state._replace(
             found_inf=self._allreduce_found_inf(state.found_inf)
         )
-        return super().update_scale(synced, metrics)
+        return super().update_scale(synced, metrics, numerics=numerics)

@@ -45,6 +45,7 @@ from ...ops.flash_attention import (
     flash_attention_available,
     flash_attention_sbhd,
 )
+from ...telemetry import numerics as _numerics
 
 Pytree = Any
 
@@ -94,6 +95,11 @@ class GPTConfig:
     # (the reference xentropy kernel's save-the-half-softmax mode). Costs
     # [b*s, vocab] saved memory in compute_dtype; saves one GEMM + one
     # reduce pass per chunk (~5 ms/step on the 345M v5e bench).
+    # Numerics caveat: this changes the FORWARD loss value itself, not
+    # just backward memory — the CE is computed over the compute_dtype-
+    # quantized logits, perturbing the loss by up to ~0.3% relative per
+    # logit at bf16 (see contrib.xentropy.lm_head_cross_entropy's
+    # save_logits_dtype docstring, where the behavior is parity-tested).
     ce_save_logits: bool = False
     # Unroll the chunked-CE loop: with ce_save_logits the [b*s, vocab]
     # buffer is materialised either way, so unrolling trades the scan's
@@ -613,37 +619,50 @@ def transformer_layer(
     fp8_l=None,  # {name: (Fp8DenseState, carrier)}, this layer's slice
 ):
     """Pre-LN transformer layer (reference ``ParallelTransformerLayer``).
-    With ``fp8_l`` set, returns ``(hidden, new_fp8_l)``."""
-    dt = hidden.dtype
-    k1 = k2 = k3 = None
-    if dropout_key is not None:
-        k1, k2, k3 = jax.random.split(dropout_key, 3)
+    With ``fp8_l`` set, returns ``(hidden, new_fp8_l)``.
 
-    ln1 = fused_layer_norm(
-        hidden.astype(jnp.float32), lp["input_ln_w"].astype(jnp.float32),
-        lp["input_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
-    ).astype(dt)
-    attn = parallel_attention(
-        cfg, lp, ln1, attention_mask, axis_name, k1, deterministic,
-        layer_number, fp8=fp8_l,
-    )
-    new_fp8 = {}
-    if fp8_l is not None:
-        attn, attn_fp8 = attn
-        new_fp8.update(attn_fp8)
-    hidden = (hidden + _dropout(attn, cfg.hidden_dropout, k3,
-                               deterministic)).astype(dt)
+    The whole layer runs under the ``apex_tpu.transformer_layer`` named
+    scope, and the attention/MLP branch outputs carry opt-in activation-
+    watch taps keyed by that scope (``telemetry.numerics.tap`` — identity
+    unless a ``numerics.activation_watch`` context is active at trace
+    time; under a differentiated layer scan the taps fire on
+    forward-only runs, the same restriction as the pipeline tick hooks).
+    """
+    with jax.named_scope("apex_tpu.transformer_layer"):
+        dt = hidden.dtype
+        k1 = k2 = k3 = None
+        if dropout_key is not None:
+            k1, k2, k3 = jax.random.split(dropout_key, 3)
 
-    ln2 = fused_layer_norm(
-        hidden.astype(jnp.float32), lp["post_ln_w"].astype(jnp.float32),
-        lp["post_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
-    ).astype(dt)
-    mlp_out = parallel_mlp(cfg, lp, ln2, axis_name, fp8=fp8_l)
-    if fp8_l is not None:
-        mlp_out, mlp_fp8 = mlp_out
-        new_fp8.update(mlp_fp8)
-    out = (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
-                             deterministic)).astype(dt)
+        ln1 = fused_layer_norm(
+            hidden.astype(jnp.float32), lp["input_ln_w"].astype(jnp.float32),
+            lp["input_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
+        ).astype(dt)
+        attn = parallel_attention(
+            cfg, lp, ln1, attention_mask, axis_name, k1, deterministic,
+            layer_number, fp8=fp8_l,
+        )
+        new_fp8 = {}
+        if fp8_l is not None:
+            attn, attn_fp8 = attn
+            new_fp8.update(attn_fp8)
+        attn = _numerics.tap(
+            "apex_tpu.transformer_layer/attn", attn, layer=layer_number)
+        hidden = (hidden + _dropout(attn, cfg.hidden_dropout, k3,
+                                   deterministic)).astype(dt)
+
+        ln2 = fused_layer_norm(
+            hidden.astype(jnp.float32), lp["post_ln_w"].astype(jnp.float32),
+            lp["post_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
+        ).astype(dt)
+        mlp_out = parallel_mlp(cfg, lp, ln2, axis_name, fp8=fp8_l)
+        if fp8_l is not None:
+            mlp_out, mlp_fp8 = mlp_out
+            new_fp8.update(mlp_fp8)
+        mlp_out = _numerics.tap(
+            "apex_tpu.transformer_layer/mlp", mlp_out, layer=layer_number)
+        out = (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
+                                 deterministic)).astype(dt)
     if fp8_l is not None:
         return out, new_fp8
     return out

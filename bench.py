@@ -130,7 +130,7 @@ def _retry_transient(fn, attempts=3, tag="bench leg"):
             if not transient or attempt == attempts - 1:
                 raise
             print(f"{tag}: transient compile-transport error, retrying "
-                  f"({attempt + 1}/{attempts - 1})", file=_sys.stderr)
+                  f"(attempt {attempt + 2}/{attempts})", file=_sys.stderr)
 
 
 # every bench leg streams per-step + summary records here
@@ -189,12 +189,16 @@ def _timed_steps(step_fn, state, iters, leg=None):
 
 def bench_gpt(iters, batch, seq, remat, master_weights=True,
               ce_save_logits=None, capture_state=False, fp8=False,
-              packed=None, telemetry_every=0, leg="gpt"):
+              packed=None, telemetry_every=0, numerics=False, leg="gpt"):
     """``telemetry_every > 0`` instruments the (non-fp8) train step with
     the in-jit ``telemetry.MetricsState`` — loss/tokens accumulated on
     device, drained to the bench JSONL every N steps through an async
     callback. Sync-free by construction; the ``telemetry_overhead`` leg
-    A/Bs this against the bare step."""
+    A/Bs this against the bare step. ``numerics=True`` instead carries
+    the ``telemetry.numerics`` health monitor: per-leaf grad stats
+    observed every step (one extra read sweep over the grads) with the
+    anomaly drain cond-gated — the ``numerics_overhead`` leg A/Bs this
+    against the bare step (healthy steps emit nothing)."""
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import (
         GPTConfig, gpt_loss, init_gpt_fp8_carriers, init_gpt_fp8_states,
@@ -265,6 +269,22 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
         # buffers); the states are KB-sized, so copying them is free
         train_step = jax.jit(train_step, donate_argnums=(0, 1))
         state = (params, opt_state, fp8_states, jnp.float32(0))
+    elif numerics:
+        from apex_tpu.telemetry import numerics as tnum
+
+        rec = telemetry_recorder()
+        mon = tnum.NumericsMonitor(params, tag=leg)
+
+        def train_step(params, opt_state, nstate, loss_prev):
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt_loss(cfg, p, tokens, labels))(params)
+            nstate = mon.observe(nstate, grads=grads)
+            params, opt_state = opt.step(grads, opt_state, params)
+            nstate = mon.drain(nstate, rec)
+            return params, opt_state, nstate, loss
+
+        train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        state = (params, opt_state, mon.init(), jnp.float32(0))
     elif telemetry_every > 0:
         from apex_tpu import telemetry
 
@@ -696,6 +716,34 @@ def main() -> None:
 
             print(f"telemetry overhead leg failed: {type(e).__name__}: {e}",
                   file=_sys.stderr)
+
+    # numerics_overhead: the headline step re-run with the numerics
+    # health monitor observing every step's grads (per-leaf norm/max/
+    # non-finite stats — one extra read sweep) and the anomaly drain
+    # cond-gated. Healthy steps emit nothing, so the A/B prices pure
+    # device arithmetic; acceptance: within 1% of the bare step.
+    # Like telemetry_overhead it is a full extra headline run — fast
+    # mode skips it unless BENCH_NUMERICS_OVERHEAD=1 forces it (the CPU
+    # smoke configuration; artifact committed under bench_artifacts/).
+    numerics_overhead = None
+    if not fast or os.environ.get("BENCH_NUMERICS_OVERHEAD") == "1":
+        try:
+            num_s, _, _ = _retry_transient(
+                lambda: bench_gpt(iters, batch, seq, remat,
+                                  numerics=True, leg="gpt_numerics"),
+                tag="numerics overhead leg")
+            overhead_pct = (num_s / step_s - 1.0) * 100.0
+            numerics_overhead = {
+                "bare_step_ms": round(step_s * 1e3, 2),
+                "instrumented_step_ms": round(num_s * 1e3, 2),
+                "overhead_pct": round(overhead_pct, 2),
+                "within_1pct": bool(overhead_pct <= 1.0),
+            }
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"numerics overhead leg failed: {type(e).__name__}: {e}",
+                  file=_sys.stderr)
     tokens_per_sec = batch * seq / step_s
     implied_tflops = flops / step_s / 1e12
     mfu = implied_tflops / peak
@@ -934,6 +982,7 @@ def main() -> None:
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,
         "telemetry_overhead": telemetry_overhead,
+        "numerics_overhead": numerics_overhead,
         "telemetry_jsonl": telemetry_recorder().path,
         "batch": batch,
         "seq": seq,

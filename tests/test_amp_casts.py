@@ -147,6 +147,20 @@ def test_list_sizes_cover_reference_surface():
     patched = len(jo.LOW_PRECISION_FUNCS) + len(jo.FP32_FUNCS)
     assert patched >= 100, patched
     assert patched + len(jo.PROMOTE_FUNCS) >= 120
+    # reference parity (ADVICE round 5): sqrt/square are NOT fp32 entries
+    # in the reference lists — only rsqrt is. Pin them off the list.
+    fp32_names = {name for _, name in jo.FP32_FUNCS}
+    assert "sqrt" not in fp32_names
+    assert "square" not in fp32_names
+
+
+def test_sqrt_square_keep_input_dtype():
+    """sqrt/square behave like unlisted ops under O1 (the reference keeps
+    them off its FP32 lists; bf16 graphs with sqrt-heavy code stay bf16)."""
+    for in_dtype in (jnp.float32, jnp.bfloat16):
+        with amp.autocast(dtype=jnp.bfloat16):
+            assert jnp.sqrt(jnp.abs(_x(in_dtype))).dtype == in_dtype
+            assert jnp.square(_x(in_dtype)).dtype == in_dtype
 
 
 # ---------------------------------------------------------------------------

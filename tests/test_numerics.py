@@ -1,0 +1,588 @@
+"""Numerics health monitor tests (apex_tpu.telemetry.numerics).
+
+Contracts pinned here:
+
+- **Overflow provenance**: poisoning ONE grad leaf with NaN inside a
+  jitted step yields an anomaly event naming exactly that leaf — on the
+  pytree path, the packed flat-buffer path (row-aligned ``PackSpec``
+  offsets), and the scaler-integrated path (per-leaf flags reused from
+  the unscale sweep). Healthy steps emit NOTHING (the ``lax.cond`` drain
+  is not taken).
+- **Anomaly rules**: grad-norm spike vs the EWMA window, loss-scale
+  collapse below the floor (edge-triggered), non-finite grads.
+- **Rank-0 gating**: events route through the PR-2 recorder sinks, so
+  non-logging ranks drop them at the sink under ``parallel_state``.
+- **Packed-vs-pytree parity**: both observation paths produce the same
+  per-leaf verdicts for the same poisoned tree.
+"""
+import functools
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import numerics
+from apex_tpu.multi_tensor_apply.packing import ROW, PackSpec
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _params():
+    return {
+        "embed": jnp.ones((4, 8)),
+        "w1": jnp.ones((2 * ROW,)),       # spans exactly 2 rows packed
+        "blk": {"w2": jnp.ones((3, 3))},
+    }
+
+
+def _grads(poison=None, value=jnp.nan):
+    g = jax.tree_util.tree_map(jnp.ones_like, _params())
+    if poison == "w1":
+        g["w1"] = g["w1"].at[ROW + 3].set(value)  # second row of the leaf
+    elif poison == "embed":
+        g["embed"] = g["embed"].at[1, 2].set(value)
+    elif poison == "w2":
+        g["blk"]["w2"] = g["blk"]["w2"].at[0, 0].set(value)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# overflow provenance
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("leaf,name", [
+    ("w1", "['w1']"), ("embed", "['embed']"), ("w2", "['blk']['w2']"),
+])
+def test_pytree_provenance_names_exactly_the_poisoned_leaf(leaf, name):
+    mon = numerics.NumericsMonitor(_params())
+    ring = telemetry.RingBufferRecorder()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(ns, grads):
+        ns = mon.observe(ns, grads=grads)
+        return mon.drain(ns, ring)
+
+    ns = mon.init()
+    for _ in range(3):  # healthy steps: no events at all
+        ns = step(ns, _grads())
+    jax.effects_barrier()
+    assert len(ring.records) == 0
+
+    ns = step(ns, _grads(poison=leaf))
+    jax.effects_barrier()
+    (ev,) = ring.records
+    assert ev["event"] == "anomaly" and ev["kind"] == "nonfinite_grads"
+    assert [l["name"] for l in ev["leaves"]] == [name]
+    assert ev["leaves"][0]["nonfinite"] == 1.0
+    assert ev["step"] == 4 and ev["first_bad_step"] == 4
+    # back to healthy: no further events
+    ring.records.clear()
+    ns = step(ns, _grads())
+    jax.effects_barrier()
+    assert len(ring.records) == 0
+
+
+@pytest.mark.parametrize("value", [jnp.nan, jnp.inf, -jnp.inf])
+def test_packed_provenance_names_exactly_the_poisoned_leaf(value):
+    spec = PackSpec(_params(), chunk_size=2 * ROW)
+    mon = numerics.NumericsMonitor(spec=spec)
+    ring = telemetry.RingBufferRecorder()
+
+    @jax.jit
+    def step(ns, flat):
+        ns = mon.observe(ns, flat_grads=flat)
+        return mon.drain(ns, ring)
+
+    ns = step(mon.init(), spec.pack(_grads(), jnp.float32))
+    jax.effects_barrier()
+    assert len(ring.records) == 0
+
+    ns = step(ns, spec.pack(_grads(poison="w1", value=value), jnp.float32))
+    jax.effects_barrier()
+    (ev,) = ring.records
+    assert [l["name"] for l in ev["leaves"]] == ["['w1']"]
+    assert ev["leaves"][0]["nonfinite"] == 1.0
+
+
+def test_packed_vs_pytree_provenance_parity():
+    """Same poisoned tree through both observation paths: identical
+    per-leaf non-finite verdicts and counts."""
+    spec = PackSpec(_params())
+    mon_tree = numerics.NumericsMonitor(_params())
+    mon_flat = numerics.NumericsMonitor(spec=spec)
+    assert mon_tree.names == mon_flat.names
+    for poison in (None, "w1", "embed", "w2"):
+        g = _grads(poison=poison)
+        ns_t = mon_tree.observe(mon_tree.init(), grads=g)
+        ns_f = mon_flat.observe(
+            mon_flat.init(), flat_grads=spec.pack(g, jnp.float32))
+        np.testing.assert_array_equal(
+            np.asarray(ns_t.grad_nonfinite), np.asarray(ns_f.grad_nonfinite))
+        assert bool(ns_t.overflow) == bool(ns_f.overflow) == (
+            poison is not None)
+        # norms agree too (one poisoned leaf -> that segment nan/inf)
+        np.testing.assert_allclose(
+            np.asarray(ns_t.grad_sq), np.asarray(ns_f.grad_sq), rtol=1e-5)
+
+
+def test_scaler_unscale_provenance_is_free_and_exact():
+    """The scaler path: per-leaf flags reused from the unscale sweep —
+    overflow event names the leaf, found_inf still trips the scaler."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    mon = numerics.NumericsMonitor(_params())
+    ring = telemetry.RingBufferRecorder()
+    sc = LossScaler("dynamic", init_scale=4.0)
+
+    @jax.jit
+    def step(sstate, ns, grads):
+        grads, sstate, ns = sc.unscale(
+            sstate, grads, numerics=(mon, ns))
+        sstate, ns = sc.update_scale(sstate, numerics=ns)
+        ns = mon.drain(ns, ring)
+        return sstate, ns
+
+    sstate, ns = sc.init_state(), mon.init()
+    sstate, ns = step(sstate, ns, _grads())
+    jax.effects_barrier()
+    assert len(ring.records) == 0
+    assert float(sstate.loss_scale) == pytest.approx(4.0)
+
+    sstate, ns = step(sstate, ns, _grads(poison="w2"))
+    jax.effects_barrier()
+    (ev,) = ring.records
+    assert [l["name"] for l in ev["leaves"]] == ["['blk']['w2']"]
+    # the scaler consumed the overflow: backed off 4 -> 2
+    assert float(sstate.loss_scale) == pytest.approx(2.0)
+    assert ev["loss_scale"] == pytest.approx(2.0)
+
+
+def test_model_parallel_grad_scaler_accepts_numerics():
+    """The TP/PP GradScaler must support the same numerics= provenance
+    wiring as the base scaler (provenance stays per-rank; the sink's
+    rank-0 gating decides who writes)."""
+    from apex_tpu.transformer.amp import GradScaler
+
+    mon = numerics.NumericsMonitor(_params())
+    ring = telemetry.RingBufferRecorder()
+    sc = GradScaler("dynamic", init_scale=4.0)
+
+    @jax.jit
+    def step(sstate, ns, grads):
+        grads, sstate, ns = sc.unscale(sstate, grads, numerics=(mon, ns))
+        sstate, ns = sc.update_scale(sstate, numerics=ns)
+        return sstate, mon.drain(ns, ring)
+
+    sstate, ns = sc.init_state(), mon.init()
+    sstate, ns = step(sstate, ns, _grads(poison="embed"))
+    jax.effects_barrier()
+    (ev,) = ring.records
+    assert [l["name"] for l in ev["leaves"]] == ["['embed']"]
+    assert float(sstate.loss_scale) == pytest.approx(2.0)
+
+
+def test_scaler_update_scale_returns_all_requested_states():
+    from apex_tpu.amp.scaler import LossScaler
+
+    sc = LossScaler("dynamic", init_scale=4.0)
+    st = sc.init_state()._replace(found_inf=jnp.asarray(True))
+    m = telemetry.init_metrics()
+    ns = numerics.NumericsMonitor(_params()).init()
+    st2, m2, ns2 = sc.update_scale(st, metrics=m, numerics=ns)
+    assert int(m2.overflow_skips) == 1
+    assert bool(ns2.overflow)
+    assert float(ns2.loss_scale) == pytest.approx(2.0)
+    assert float(ns2.prev_loss_scale) == pytest.approx(4.0)
+    st3, ns3 = sc.update_scale(st, numerics=ns)
+    assert isinstance(st3, type(st)) and bool(ns3.overflow)
+
+
+# ---------------------------------------------------------------------------
+# anomaly rules
+# ---------------------------------------------------------------------------
+
+def test_grad_spike_vs_ewma_window():
+    mon = numerics.NumericsMonitor(
+        _params(), spike_warmup=3, spike_factor=5.0)
+    ring = telemetry.RingBufferRecorder()
+
+    @jax.jit
+    def step(ns, grads):
+        ns = mon.observe(ns, grads=grads)
+        return mon.drain(ns, ring)
+
+    ns = mon.init()
+    for _ in range(5):
+        ns = step(ns, _grads())
+    jax.effects_barrier()
+    assert len(ring.records) == 0  # steady norms: no spike
+    big = jax.tree_util.tree_map(lambda g: g * 100.0, _grads())
+    ns = step(ns, big)
+    jax.effects_barrier()
+    (ev,) = ring.records
+    assert ev["kind"] == "grad_spike"
+    assert ev["ratio"] == pytest.approx(100.0, rel=0.05)
+    assert ev["grad_norm"] > ev["ewma_norm"]
+
+
+def test_spike_needs_warmup():
+    mon = numerics.NumericsMonitor(
+        _params(), spike_warmup=10, spike_factor=5.0)
+    ring = telemetry.RingBufferRecorder()
+    ns = mon.init()
+    ns = mon.observe(ns, grads=_grads())
+    ns = mon.observe(
+        ns, grads=jax.tree_util.tree_map(lambda g: g * 100.0, _grads()))
+    ns = mon.drain(ns, ring)
+    jax.effects_barrier()
+    assert len(ring.records) == 0  # inside warmup: spike suppressed
+
+
+def test_scale_collapse_edge_triggered():
+    from apex_tpu.amp.scaler import LossScaler
+
+    mon = numerics.NumericsMonitor(_params(), scale_floor=2.0)
+    ring = telemetry.RingBufferRecorder()
+    sc = LossScaler("dynamic", init_scale=4.0)
+
+    @jax.jit
+    def overflow_step(sstate, ns):
+        sstate = sstate._replace(found_inf=jnp.asarray(True))
+        sstate, ns = sc.update_scale(sstate, numerics=ns)
+        ns = mon.drain(ns, ring)
+        return sstate, ns
+
+    sstate, ns = sc.init_state(), mon.init()
+    sstate, ns = overflow_step(sstate, ns)  # 4 -> 2: above floor
+    sstate, ns = overflow_step(sstate, ns)  # 2 -> 1: CROSSES the floor
+    sstate, ns = overflow_step(sstate, ns)  # 1 -> 0.5: already below
+    jax.effects_barrier()
+    collapses = [r for r in ring.records if r["kind"] == "scale_collapse"]
+    assert len(collapses) == 1  # emitted on the crossing only
+    assert collapses[0]["loss_scale"] == pytest.approx(1.0)
+    assert collapses[0]["prev_loss_scale"] == pytest.approx(2.0)
+    assert collapses[0]["floor"] == pytest.approx(2.0)
+
+
+def test_health_every_periodic_table():
+    mon = numerics.NumericsMonitor(_params())
+    ring = telemetry.RingBufferRecorder()
+
+    @jax.jit
+    def step(ns, grads):
+        ns = mon.observe(ns, grads=grads)
+        return mon.drain(ns, ring, health_every=2)
+
+    ns = mon.init()
+    for _ in range(5):
+        ns = step(ns, _grads())
+    jax.effects_barrier()
+    health = [r for r in ring.records if r["event"] == "numerics_health"]
+    assert [r["step"] for r in health] == [2, 4]
+    leaves = health[-1]["leaves"]
+    assert set(leaves) == set(mon.names)
+    assert leaves["['w1']"]["norm"] == pytest.approx(
+        float(np.sqrt(2 * ROW)), rel=1e-5)
+    assert leaves["['w1']"]["nonfinite"] == 0.0
+
+
+def test_numerics_state_donatable():
+    mon = numerics.NumericsMonitor(_params())
+    step = jax.jit(lambda ns, g: mon.observe(ns, grads=g),
+                   donate_argnums=(0,))
+    ns = step(mon.init(), _grads())
+    ns = step(ns, _grads())
+    assert int(ns.step) == 2
+
+
+def test_observe_validates_sources():
+    mon = numerics.NumericsMonitor(_params())
+    ns = mon.init()
+    with pytest.raises(ValueError, match="exactly one"):
+        mon.observe(ns)
+    with pytest.raises(ValueError, match="exactly one"):
+        mon.observe(ns, grads=_grads(),
+                    flat_grads=jnp.zeros((ROW,)))
+    with pytest.raises(ValueError, match="leaves"):
+        mon.observe(ns, grads={"just_one": jnp.ones((3,))})
+    with pytest.raises(ValueError, match="PackSpec"):
+        mon.observe(ns, flat_grads=jnp.zeros((ROW,)))
+    with pytest.raises(ValueError, match="exactly one of"):
+        numerics.NumericsMonitor(None)
+
+
+# ---------------------------------------------------------------------------
+# rank-0 gating through the recorder sinks
+# ---------------------------------------------------------------------------
+
+def test_anomaly_events_rank_gated_under_parallel_state(tmp_path):
+    from apex_tpu.transformer import parallel_state
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 4+ virtual-device harness")
+    parallel_state.initialize_model_parallel(
+        1, 4, devices=jax.devices()[:4])
+    try:
+        # this process owns the first mesh device -> it IS the logging
+        # process; an explicit other-rank gate must drop
+        assert telemetry.is_logging_process() is True
+        mon = numerics.NumericsMonitor(_params())
+        logged = tmp_path / "rank0.jsonl"
+        dropped = tmp_path / "rank3.jsonl"
+        rec0 = telemetry.JsonlRecorder(logged)
+        rec3 = telemetry.JsonlRecorder(dropped, log_rank=3)
+        sink = telemetry.MultiRecorder(rec0, rec3)
+
+        @jax.jit
+        def step(ns, grads):
+            ns = mon.observe(ns, grads=grads)
+            return mon.drain(ns, sink)
+
+        step(mon.init(), _grads(poison="w1"))
+        jax.effects_barrier()
+        rec0.close()
+        rec3.close()
+        (ev,) = telemetry.read_jsonl(logged)
+        assert ev["kind"] == "nonfinite_grads"
+        assert not dropped.exists()  # non-logging rank dropped at sink
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+# ---------------------------------------------------------------------------
+# activation watch
+# ---------------------------------------------------------------------------
+
+def test_tap_identity_and_watch_emission():
+    x = jnp.arange(8.0)
+    assert numerics.tap("t", x) is x  # no watch: literally identity
+    ring = telemetry.RingBufferRecorder()
+    with numerics.activation_watch(ring, tag="unit"):
+        assert numerics.watching()
+        y = jax.jit(lambda v: numerics.tap("t/x", v, layer=3) * 2.0)(x)
+        jax.effects_barrier()
+    assert not numerics.watching()
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2.0)
+    (r,) = ring.records
+    assert r["event"] == "activation" and r["name"] == "t/x"
+    assert r["layer"] == 3 and r["tag"] == "unit"
+    assert r["maxabs"] == pytest.approx(7.0)
+    assert r["norm"] == pytest.approx(float(np.linalg.norm(np.arange(8.0))))
+
+
+def test_tap_only_nonfinite_gates_healthy_activations():
+    ring = telemetry.RingBufferRecorder()
+    with numerics.activation_watch(ring, only_nonfinite=True):
+        f = jax.jit(lambda v: numerics.tap("t", v))
+        f(jnp.ones((4,)))
+        f(jnp.array([1.0, jnp.nan, 1.0, 1.0]))
+        jax.effects_barrier()
+    (r,) = ring.records  # only the poisoned call emitted
+    assert r["nonfinite"] == 1.0
+
+
+def test_transformer_layer_taps_report_per_layer():
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        gpt_forward,
+    )
+
+    cfg = GPTConfig(num_layers=2, hidden_size=64, num_attention_heads=4,
+                    vocab_size=128, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+
+    bare = jax.jit(lambda p: gpt_forward(cfg, p, tokens)[0])(params)
+    ring = telemetry.RingBufferRecorder()
+    with numerics.activation_watch(ring):
+        watched = jax.jit(lambda p: gpt_forward(cfg, p, tokens)[0])(params)
+        jax.effects_barrier()
+    np.testing.assert_allclose(np.asarray(bare), np.asarray(watched))
+    recs = list(ring.records)
+    # 2 taps (attn, mlp) x 2 layers, layer numbers from the scan
+    assert sorted((r["name"].rsplit("/", 1)[1], r["layer"])
+                  for r in recs) == [
+        ("attn", 1), ("attn", 2), ("mlp", 1), ("mlp", 2)]
+    assert all(r["nonfinite"] == 0.0 for r in recs)
+
+
+def test_transformer_layer_named_scope_reaches_lowered_hlo():
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        gpt_forward,
+    )
+
+    cfg = GPTConfig(num_layers=1, hidden_size=32, num_attention_heads=2,
+                    vocab_size=64, max_position_embeddings=16,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    text = jax.jit(
+        lambda p: gpt_forward(cfg, p, tokens)[0]
+    ).lower(params).compile().as_text()
+    assert "apex_tpu.transformer_layer" in text
+
+
+def test_packed_adam_grad_tap_names_guilty_leaves():
+    from apex_tpu.optimizers import FusedAdam
+
+    params = {"a": jnp.ones((ROW,), jnp.float32),
+              "b": jnp.ones((ROW,), jnp.float32)}
+    opt = FusedAdam(lr=1e-3, packed=True)
+    state = opt.init(params)
+    grads = {"a": jnp.ones((ROW,)),
+             "b": jnp.ones((ROW,)).at[5].set(jnp.nan)}
+    ring = telemetry.RingBufferRecorder()
+    with numerics.activation_watch(ring):
+        step = jax.jit(lambda g, s, p: opt.step(g, s, p))
+        step(grads, state, params)
+        jax.effects_barrier()
+    tap_recs = [r for r in ring.records
+                if r["name"] == "apex_tpu.packed_adam/grads"]
+    assert len(tap_recs) == 1
+    assert tap_recs[0]["nonfinite"] == 1.0
+    assert [l["name"] for l in tap_recs[0]["leaves"]] == ["['b']"]
+
+
+# ---------------------------------------------------------------------------
+# kernel-layer plumbing
+# ---------------------------------------------------------------------------
+
+def test_packed_row_stats_kernel_matches_fallback():
+    from apex_tpu.ops.packed_optimizer import packed_row_stats
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4 * ROW,)).astype(np.float32)
+    x[ROW + 1] = np.nan
+    x[3 * ROW + 7] = np.inf
+    fb = packed_row_stats(jnp.asarray(x), inv_scale=0.5, use_kernel=False)
+    kr = packed_row_stats(jnp.asarray(x), inv_scale=0.5, interpret=True)
+    for a, b in zip(fb, kr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # exact non-finite counts land in the right rows
+    np.testing.assert_array_equal(
+        np.asarray(fb[2]), np.array([0.0, 1.0, 0.0, 1.0], np.float32))
+
+
+def test_multi_tensor_scale_flat_per_row_flags():
+    from apex_tpu.ops.packed_optimizer import multi_tensor_scale_flat
+
+    x = jnp.ones((3 * ROW,)).at[2 * ROW + 4].set(jnp.inf)
+    for kw in ({"use_kernel": False}, {"interpret": True}):
+        out, found, rows = multi_tensor_scale_flat(
+            x, 1.0, per_row_flags=True, **kw)
+        assert bool(found)
+        np.testing.assert_array_equal(
+            np.asarray(rows), np.array([False, False, True]))
+        # 2-ary contract unchanged
+        out2, found2 = multi_tensor_scale_flat(x, 1.0, **kw)
+        assert bool(found2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_multi_tensor_scale_per_tensor_flags():
+    from apex_tpu.ops.multi_tensor import multi_tensor_scale
+
+    tree = {"a": jnp.ones((4,)), "b": jnp.array([1.0, jnp.nan])}
+    out, found, flags = multi_tensor_scale(tree, 2.0, per_tensor=True)
+    assert bool(found)
+    np.testing.assert_array_equal(np.asarray(flags),
+                                  np.array([False, True]))
+    out2, found2 = multi_tensor_scale(tree, 2.0)
+    assert bool(found2)
+
+
+def test_pack_spec_leaf_names_flatten_order():
+    spec = PackSpec(_params())
+    # dict flattening is key-sorted; names must match tree_leaves order
+    assert spec.leaf_names() == ("['blk']['w2']", "['embed']", "['w1']")
+    assert spec.leaf_names() == numerics.leaf_names(_params())
+
+
+# ---------------------------------------------------------------------------
+# legacy host-driven scaler provenance
+# ---------------------------------------------------------------------------
+
+def test_legacy_dynamic_scaler_provenance_and_sink():
+    from apex_tpu.fp16_utils import DynamicLossScaler, nonfinite_leaves
+
+    g = _grads(poison="embed")
+    assert nonfinite_leaves(g) == ["['embed']"]
+    assert nonfinite_leaves(_grads()) == []
+
+    ring = telemetry.RingBufferRecorder()
+    sc = DynamicLossScaler(init_scale=2.0 ** 8, sink=ring)
+    assert sc.has_overflow(g) is True
+    assert sc.last_overflow_leaves == ["['embed']"]
+    sc.update_scale(True)
+    assert sc.cur_scale == pytest.approx(2.0 ** 7)
+    (ev,) = ring.records
+    assert ev["kind"] == "nonfinite_grads"
+    assert ev["leaves"] == [{"name": "['embed']"}]
+    # clean path emits nothing
+    assert sc.has_overflow(_grads()) is False
+    sc.update_scale(False)
+    assert len(ring.records) == 1
+
+
+# ---------------------------------------------------------------------------
+# health report tool
+# ---------------------------------------------------------------------------
+
+def test_health_report_aggregation_and_render(tmp_path):
+    from tools.health_report import health_from_records, render_report
+
+    records = [
+        {"event": "metrics", "step": 10, "loss": 2.5, "loss_scale": 1024.0,
+         "overflow_skips": 1, "scale_growths": 0},
+        {"event": "anomaly", "kind": "nonfinite_grads", "step": 7,
+         "loss_scale": 2048.0, "first_bad_step": 7,
+         "leaves": [{"name": "['w1']", "nonfinite": 3.0,
+                     "maxabs": "inf", "norm": "nan"}]},
+        {"event": "anomaly", "kind": "grad_spike", "step": 9,
+         "grad_norm": 90.0, "ewma_norm": 3.0, "ratio": 30.0},
+        {"event": "numerics_health", "step": 8,
+         "leaves": {"['w1']": {"norm": 1.5, "maxabs": 0.5,
+                               "nonfinite": 0.0},
+                    "['embed']": {"norm": 2.0, "maxabs": 1.0,
+                                  "nonfinite": 0.0}}},
+        {"event": "activation", "name": "apex_tpu.transformer_layer/mlp",
+         "layer": 2, "maxabs": 4.0, "nonfinite": 1.0, "norm": 9.0,
+         "step": 7},
+    ]
+    h = health_from_records(records)
+    assert h["first_bad_step"] == 7
+    assert h["anomaly_counts"] == {"nonfinite_grads": 1, "grad_spike": 1}
+    assert h["leaves"]["['w1']"]["first_bad_step"] == 7
+    assert h["leaves"]["['w1']"]["nonfinite_events"] == 1
+    assert h["leaves"]["['w1']"]["last_norm"] == pytest.approx(1.5)
+    assert h["leaves"]["['embed']"]["first_bad_step"] is None
+    tap = h["taps"]["apex_tpu.transformer_layer/mlp@layer2"]
+    assert tap["nonfinite_events"] == 1 and tap["first_bad_step"] == 7
+    assert h["run"]["loss_scale"] == pytest.approx(1024.0)
+
+    text = render_report(h)
+    assert "first bad step: 7" in text
+    assert "['w1']" in text and "@layer2" in text
+
+
+def test_health_report_cli_roundtrip(tmp_path):
+    from tools.health_report import main
+
+    path = tmp_path / "run.jsonl"
+    with telemetry.JsonlRecorder(path) as rec:
+        rec.record({"event": "anomaly", "kind": "nonfinite_grads",
+                    "step": 3, "loss_scale": 8.0,
+                    "leaves": [{"name": "['w1']", "nonfinite": 1.0,
+                                "maxabs": float("nan"),
+                                "norm": float("nan")}]})
+    assert main([str(path)]) == 1          # non-finite run: CI-gateable
+    healthy = tmp_path / "ok.jsonl"
+    with telemetry.JsonlRecorder(healthy) as rec:
+        rec.record({"event": "metrics", "step": 5, "loss": 1.0})
+    assert main([str(healthy)]) == 0

@@ -2,14 +2,24 @@
 
 The profiling capture itself needs a real TPU; the parsing/classification
 logic is pure and pinned here so a refactor cannot silently misbucket the
-published bench breakdown.
+published bench breakdown. The golden xplane fixtures at the bottom build
+REAL xplane protobufs and pin the corrected category attribution
+end-to-end (round-5 VERDICT: generic ``%fusion.N`` ops were all booked as
+"fusion(elementwise)", hiding the dense GEMMs — 42.7% of the GPT step
+mislabeled).
 """
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from tools.op_breakdown import _category, _short_op_name  # noqa: E402
+from apex_tpu.telemetry.tracing import (  # noqa: E402
+    breakdown_table,
+    parse_xspace_op_times,
+)
 
 
 def test_short_op_name_strips_hlo_decoration():
@@ -36,3 +46,140 @@ def test_category_buckets():
     assert _category("exponential_reduce_fusion") == "reduce"
     assert _category("select_add_fusion") == "fusion(elementwise)"
     assert _category("iota") == "other"
+
+
+def test_category_hlo_category_stat_is_authoritative():
+    """The profiler's per-op category (from the fused computation's root
+    op) overrides the generic name — the round-5 fix."""
+    assert _category("fusion", "convolution fusion") == "matmul/conv"
+    assert _category("fusion", "loop fusion") == "fusion(elementwise)"
+    assert _category("fusion", "output fusion") == "fusion(elementwise)"
+    assert _category("fusion", "all-reduce fusion") == "collective"
+    assert _category("fusion", "reduce fusion") == "reduce"
+    # a named fusion with a contradicting stat: the stat wins
+    assert _category("select_add_fusion", "convolution fusion") \
+        == "matmul/conv"
+
+
+def test_category_generic_fusion_without_signal_is_unattributed():
+    """A bare %fusion.N with no hlo_category and no callee signal must
+    NOT be claimed as elementwise — that is the exact round-5 bug."""
+    assert _category("fusion") == "fusion(unattributed)"
+    assert _category("loop_fusion") == "fusion(unattributed)"
+    assert _category("fused_computation") == "fusion(unattributed)"
+
+
+def test_category_generic_fusion_salvaged_from_callee():
+    raw = ("%fusion.3 = bf16[4,4]{1,0} fusion(%p0, %p1), kind=kOutput, "
+           "calls=%convolution_fusion.3")
+    assert _category("fusion", None, raw) == "matmul/conv"
+    raw2 = "%fusion.9 = f32[8] fusion(%p0), kind=kLoop, calls=%fused_computation.9"
+    assert _category("fusion", None, raw2) == "fusion(unattributed)"
+
+
+# ---------------------------------------------------------------------------
+# golden xplane fixtures: real protobufs, end-to-end through the parser
+# ---------------------------------------------------------------------------
+
+def _build_xplane(tmp_path, ops):
+    """Write a minimal real .xplane.pb: ops = [(name, ps, category|None)]."""
+    xplane_pb2 = pytest.importorskip(
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    cat_md = plane.stat_metadata[1]
+    cat_md.id = 1
+    cat_md.name = "hlo_category"
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    for i, (name, ps, cat) in enumerate(ops, start=1):
+        md = plane.event_metadata[i]
+        md.id = i
+        md.name = name
+        ev = line.events.add()
+        ev.metadata_id = i
+        ev.duration_ps = ps
+        if cat is not None:
+            st = ev.stats.add()
+            st.metadata_id = 1
+            st.str_value = cat
+    # a non-TPU plane that must be ignored
+    host = xs.planes.add()
+    host.name = "/host:CPU"
+    hl = host.lines.add()
+    hl.name = "XLA Ops"
+    (tmp_path / "plugins").mkdir(exist_ok=True)
+    out = tmp_path / "plugins" / "host.xplane.pb"
+    out.write_bytes(xs.SerializeToString())
+    return str(tmp_path)
+
+
+GOLDEN_OPS = [
+    # the round-5 shape: generic fusions dominated by a conv-rooted one
+    ("fusion.1", 700_000, "convolution fusion"),
+    ("fusion.2", 150_000, "loop fusion"),
+    ("fusion.3", 50_000, None),                      # no stat: unattributed
+    ("apex_tpu_flash_fwd.65", 80_000, "custom-call"),
+    ("copy.4", 10_000, "copy"),
+    ("while.9", 999_999, None),                      # container: excluded
+    ("all-reduce.5", 10_000, "all-reduce"),
+]
+
+# the pinned golden table for GOLDEN_OPS at n_steps=1
+GOLDEN_CATEGORIES = {
+    "matmul/conv": 70.0,
+    "fusion(elementwise)": 15.0,
+    "fusion(unattributed)": 5.0,
+    "attention-kernel": 8.0,
+    "data-movement": 1.0,
+    "collective": 1.0,
+}
+
+
+def test_golden_xplane_fixture_end_to_end(tmp_path):
+    trace_dir = _build_xplane(tmp_path, GOLDEN_OPS)
+    total, per_op = parse_xspace_op_times(trace_dir)
+    assert total == 1_000_000  # container excluded
+    assert per_op[("fusion", "matmul/conv")] == 700_000
+    assert per_op[("fusion", "fusion(elementwise)")] == 150_000
+    assert per_op[("fusion", "fusion(unattributed)")] == 50_000
+    table = breakdown_table(total, per_op, n_steps=1, top=10)
+    got = {cat: row["pct"] for cat, row in table["categories"].items()}
+    assert got == pytest.approx(GOLDEN_CATEGORIES)
+    # top op is the conv-rooted fusion, labeled as matmul/conv
+    assert table["ops"][0]["op"] == "fusion"
+    assert table["ops"][0]["category"] == "matmul/conv"
+    assert table["ops"][0]["pct"] == pytest.approx(70.0)
+
+
+def test_golden_xplane_ref_value_category(tmp_path):
+    """hlo_category delivered via stat_metadata ref_value indirection
+    (the other xplane encoding) must resolve identically."""
+    xplane_pb2 = pytest.importorskip(
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    key_md = plane.stat_metadata[1]
+    key_md.id = 1
+    key_md.name = "hlo_category"
+    val_md = plane.stat_metadata[2]
+    val_md.id = 2
+    val_md.name = "convolution fusion"
+    md = plane.event_metadata[1]
+    md.id = 1
+    md.name = "fusion.7"
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    ev = line.events.add()
+    ev.metadata_id = 1
+    ev.duration_ps = 42
+    st = ev.stats.add()
+    st.metadata_id = 1
+    st.ref_value = 2
+    out = tmp_path / "t.xplane.pb"
+    out.write_bytes(xs.SerializeToString())
+    total, per_op = parse_xspace_op_times(str(tmp_path))
+    assert total == 42
+    assert per_op == {("fusion", "matmul/conv"): 42}

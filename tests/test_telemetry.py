@@ -432,8 +432,29 @@ def test_aggregate_op_times_fixture():
     ]
     total, per_op = telemetry.aggregate_op_times(events)
     assert total == 375  # containers excluded, suffixes merged
-    assert per_op == {"convolution_tanh_fusion": 150,
-                      "apex_tpu_flash_fwd": 200, "copy-done": 25}
+    assert per_op == {
+        ("convolution_tanh_fusion", "matmul/conv"): 150,
+        ("apex_tpu_flash_fwd", "attention-kernel"): 200,
+        ("copy-done", "data-movement"): 25,
+    }
+
+
+def test_aggregate_generic_fusions_split_by_hlo_category():
+    """The round-5 misattribution: every generic %fusion.N merged into
+    one 'fusion' op booked as elementwise, hiding the dense GEMMs. With
+    the profiler's hlo_category stat they stay separate."""
+    events = [
+        ("%fusion.1 = bf16[4,4] fusion(...)", 700, "convolution fusion"),
+        ("%fusion.2 = f32[4] fusion(...)", 200, "loop fusion"),
+        ("%fusion.3 = f32[4] fusion(...)", 100, None),  # no stat
+    ]
+    total, per_op = telemetry.aggregate_op_times(events)
+    assert total == 1000
+    assert per_op == {
+        ("fusion", "matmul/conv"): 700,
+        ("fusion", "fusion(elementwise)"): 200,
+        ("fusion", "fusion(unattributed)"): 100,
+    }
 
 
 def test_breakdown_table_fixture():
@@ -446,9 +467,18 @@ def test_breakdown_table_fixture():
     assert table["device_ms_per_step"] == pytest.approx(0.002)
     assert len(table["ops"]) == 1  # top=1
     assert table["ops"][0]["op"] == "dot_fusion"
+    assert table["ops"][0]["category"] == "matmul/conv"
     assert table["ops"][0]["pct"] == pytest.approx(75.0)
     assert table["categories"]["collective"]["pct"] == pytest.approx(25.0)
     assert telemetry.breakdown_table(0, {}) is None
+
+
+def test_breakdown_table_accepts_legacy_name_keyed_per_op():
+    # pre-fix captures keyed per_op by bare name; the table still builds
+    table = telemetry.breakdown_table(
+        1_000_000, {"dot_fusion": 750_000, "copy": 250_000})
+    assert table["categories"]["matmul/conv"]["pct"] == pytest.approx(75.0)
+    assert table["categories"]["data-movement"]["pct"] == pytest.approx(25.0)
 
 
 def test_profile_step_cost_analysis_fallback_on_cpu():
