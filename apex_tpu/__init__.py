@@ -79,6 +79,7 @@ from . import normalization  # noqa: F401,E402
 from . import multi_tensor_apply  # noqa: F401,E402
 
 _LAZY_SUBMODULES = (
+    "analysis",
     "parallel",
     "transformer",
     "contrib",

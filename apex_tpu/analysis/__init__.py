@@ -1,0 +1,47 @@
+"""apex_tpu.analysis: static auditing of traced training steps.
+
+The invariants PRs 1–3 rely on — packed state donated into the jitted
+step, debug callbacks cond-gated, matmuls in low precision, PackSpec
+ROW/chunk alignment — are enforced here mechanically, by tracing the
+step with ``jax.make_jaxpr`` (no execution, runs on CPU) and walking
+the jaxpr. Audit the program, not the run.
+
+Entry points:
+
+- :func:`audit_step` — trace + run the rule families, returns an
+  :class:`AuditReport` of structured :class:`Finding` records;
+- :func:`assert_step_clean` — the pytest one-liner (raises on findings
+  at/above a severity);
+- :func:`check_pack_spec` — standalone :class:`PackSpec` verification
+  (the ROADMAP sharded-packed precondition);
+- ``RULES`` — the rule registry (``donation``, ``host_sync``,
+  ``dtype_flow``, ``constants``, ``packing``, ``scopes``).
+
+CLI: ``python tools/static_audit.py --self`` audits the repo's own
+headline steps (CI-gateable exit codes). See ``docs/static_analysis.md``.
+"""
+from .auditor import (  # noqa: F401
+    StepTrace,
+    assert_step_clean,
+    audit_step,
+    trace_step,
+)
+from .report import AuditReport, Finding, SEVERITIES  # noqa: F401
+from .rules import RULES, AuditConfig, check_pack_spec  # noqa: F401
+from .walk import WalkCtx, collect_consts, walk  # noqa: F401
+
+__all__ = [
+    "AuditConfig",
+    "AuditReport",
+    "Finding",
+    "RULES",
+    "SEVERITIES",
+    "StepTrace",
+    "WalkCtx",
+    "assert_step_clean",
+    "audit_step",
+    "check_pack_spec",
+    "collect_consts",
+    "trace_step",
+    "walk",
+]

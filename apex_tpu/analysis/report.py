@@ -1,0 +1,140 @@
+"""Structured findings: the auditor's output schema.
+
+Every rule emits :class:`Finding` records; :class:`AuditReport` is the
+ordered, JSON-stable collection the CLI (``tools/static_audit.py``), the
+pytest helper (:func:`apex_tpu.analysis.assert_step_clean`) and the bench
+``audit`` summary all consume. Stability contract: :meth:`AuditReport.to_json`
+contains no timestamps, object ids, or host paths — two audits of the same
+program produce byte-identical JSON, so golden-fixture tests can pin it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+# severity ordering for sorting and gating (lower = more severe)
+SEVERITIES = ("error", "warning", "info")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation (or observation) from one rule.
+
+    ``rule`` is the rule family (``donation`` / ``host_sync`` /
+    ``dtype_flow`` / ``constants`` / ``packing`` / ``scopes``); ``code``
+    the specific check within it (e.g. ``undonated_state``); ``where`` a
+    human-readable anchor (arg path, name stack, eqn summary); ``data``
+    JSON-scalar extras (byte counts, dtypes, paths).
+    """
+
+    rule: str
+    code: str
+    severity: str
+    message: str
+    where: str = ""
+    data: Optional[Dict] = None
+
+    def __post_init__(self):
+        if self.severity not in _SEV_RANK:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got {self.severity!r}")
+
+    def sort_key(self) -> Tuple:
+        return (_SEV_RANK[self.severity], self.rule, self.code, self.where,
+                self.message)
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "where": self.where,
+        }
+        if self.data:
+            d["data"] = {k: self.data[k] for k in sorted(self.data)}
+        return d
+
+
+class AuditReport:
+    """Sorted findings + counts for one audited step."""
+
+    def __init__(self, name: str, findings: List[Finding],
+                 rules_run: Tuple[str, ...] = ()):
+        self.name = name
+        self.findings = sorted(findings, key=Finding.sort_key)
+        self.rules_run = tuple(rules_run)
+
+    # -- queries -----------------------------------------------------------
+    def by_severity(self, severity: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return self.by_severity("error")
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return self.by_severity("warning")
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def codes(self) -> List[str]:
+        return [f.code for f in self.findings]
+
+    @property
+    def ok(self) -> bool:
+        """No error-severity findings (the CI gate)."""
+        return not self.errors
+
+    def counts(self) -> Dict[str, int]:
+        c = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            c[f.severity] += 1
+        return c
+
+    # -- rendering ---------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ok": self.ok,
+            "counts": self.counts(),
+            "rules_run": list(self.rules_run),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=False)
+
+    def table(self, max_width: int = 100) -> str:
+        """Fixed-width human table (the tools/health_report.py idiom)."""
+        head = (f"audit: {self.name}  "
+                + "  ".join(f"{k}={v}" for k, v in self.counts().items()))
+        if not self.findings:
+            return head + "\nclean — no findings"
+        headers = ["sev", "rule", "code", "where", "message"]
+        rows = [
+            [f.severity, f.rule, f.code,
+             _clip(f.where, 36), _clip(f.message, max_width)]
+            for f in self.findings
+        ]
+        widths = [max(len(h), *(len(r[i]) for r in rows))
+                  for i, h in enumerate(headers)]
+        lines = [head,
+                 "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+                 "  ".join("-" * w for w in widths)]
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+                  for r in rows]
+        return "\n".join(lines)
+
+    def __repr__(self):
+        c = self.counts()
+        return (f"AuditReport({self.name!r}, errors={c['error']}, "
+                f"warnings={c['warning']}, info={c['info']})")
+
+
+def _clip(s: str, n: int) -> str:
+    return s if len(s) <= n else s[: n - 1] + "…"

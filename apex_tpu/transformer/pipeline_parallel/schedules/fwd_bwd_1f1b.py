@@ -69,6 +69,7 @@ from .common import emit_tick
 Pytree = Any
 
 
+@jax.named_scope("apex_tpu.pipeline_1f1b")
 def pipeline_forward_backward_1f1b(
     stage_fn: Callable,
     loss_fn: Callable,

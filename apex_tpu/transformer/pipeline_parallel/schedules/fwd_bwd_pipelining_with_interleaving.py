@@ -34,6 +34,7 @@ from .fwd_bwd_pipelining_without_interleaving import (
 Pytree = Any
 
 
+@jax.named_scope("apex_tpu.pipeline_interleaved")
 def pipeline_forward_backward_interleaved(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -73,6 +74,7 @@ def pipeline_forward_backward_interleaved(
     )
 
 
+@jax.named_scope("apex_tpu.pipeline_interleaved")
 def run_pipeline_interleaved(
     mesh,
     stage_fn: Callable,

@@ -466,13 +466,12 @@ def parallel_attention(
         if qk_scaling:
             coeff = jnp.maximum(layer_number.astype(jnp.float32), 1.0)
             norm_factor = norm_factor * coeff
-        scores = jnp.einsum(
-            "sbnh,tbnh->bnst", q, kk, preferred_element_type=jnp.float32
-        ) / norm_factor
-
-        if coeff is not None:
             # traced scale: inline fp32 softmax (the Pallas kernel needs a
             # static scale; fp16+layer-scaling takes the XLA path)
+            scores = jnp.einsum(
+                "sbnh,tbnh->bnst", q, kk,
+                preferred_element_type=jnp.float32
+            ) / norm_factor
             x = scores * coeff
             if causal:
                 qi = jax.lax.broadcasted_iota(jnp.int32, x.shape[-2:], 0)
@@ -490,8 +489,20 @@ def parallel_attention(
                 softmax_in_fp32=True,
                 scale=None,
             )
+            # scores come off the MXU in compute dtype directly (the
+            # accumulator is fp32 internally and rounds ONCE at the
+            # output) — the old preferred_element_type=fp32 einsum
+            # followed by a compute-dtype truncation was a pure
+            # f32->bf16->f32 round-trip into the fp32 fused softmax
+            # (the analysis.dtype_flow 'double_cast' finding): mantissa
+            # already lost, two convert sweeps paid. Keeping scores in
+            # compute dtype also keeps the [b, np, sq, sk] probs
+            # residual (the largest attention activation on this path)
+            # at compute-dtype width, matching the dispatcher's
+            # input_in_* flags.
+            scores = jnp.einsum("sbnh,tbnh->bnst", q, kk) / norm_factor
             probs = softmax(
-                scores.astype(cfg.compute_dtype),
+                scores,
                 None if causal else attention_mask,
             )
 

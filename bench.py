@@ -330,6 +330,32 @@ def bench_gpt(iters, batch, seq, remat, master_weights=True,
 _gpt_step_for_breakdown = None
 
 
+def gpt_step_audit():
+    """Static audit of the ACTUAL headline train step (tracing only, no
+    execution — see apex_tpu.analysis): donation coverage, host-sync
+    discipline, dtype flow, constant bloat, PackSpec invariants. The
+    summary rides the bench JSON (``"audit"``) so every capture records
+    the invariant status alongside the perf numbers
+    (tools/compare_bench.py surfaces it). Must run BEFORE
+    gpt_op_breakdown, which releases the retained step. BENCH_AUDIT=0
+    skips (the re-trace of the unrolled 24-layer step costs host time)."""
+    if _gpt_step_for_breakdown is None:
+        return None
+    try:
+        from apex_tpu.analysis import audit_step
+
+        step_fn, state = _gpt_step_for_breakdown
+        rep = audit_step(step_fn, *state, name="gpt_headline")
+        return {"ok": rep.ok, **rep.counts(),
+                "codes": sorted(set(rep.codes()))}
+    except Exception as e:  # the audit must never sink the bench
+        import sys as _sys
+
+        print(f"headline step audit failed: {type(e).__name__}: {e}",
+              file=_sys.stderr)
+        return None
+
+
 def gpt_op_breakdown(top=10):
     """Top-op device-time table for the headline GPT step (VERDICT r4 #1:
     publish WHERE the milliseconds go). Off-TPU this is the
@@ -683,9 +709,12 @@ def main() -> None:
         tag="gpt headline")
     if not math.isfinite(final_loss):
         raise SystemExit(f"final loss is not finite: {final_loss}")
-    # profile the HEADLINE step; gpt_op_breakdown releases the retained
-    # train state in its finally block (it must not stay live through
-    # the later legs)
+    # audit, then profile, the HEADLINE step; gpt_op_breakdown releases
+    # the retained train state in its finally block (it must not stay
+    # live through the later legs)
+    audit = (gpt_step_audit()
+             if want_breakdown and os.environ.get("BENCH_AUDIT", "1") != "0"
+             else None)
     op_breakdown = gpt_op_breakdown() if want_breakdown else None
 
     # telemetry_overhead: the headline step re-run with the in-jit
@@ -981,6 +1010,7 @@ def main() -> None:
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,
+        "audit": audit,
         "telemetry_overhead": telemetry_overhead,
         "numerics_overhead": numerics_overhead,
         "telemetry_jsonl": telemetry_recorder().path,

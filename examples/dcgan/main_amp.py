@@ -19,6 +19,7 @@ point).
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 
 
@@ -125,7 +126,12 @@ def main():
     grad_d_fake = amp.scaled_value_and_grad(d_fake_loss, scalers[1])
     grad_g = amp.scaled_value_and_grad(g_loss, scalers[2])
 
-    @jax.jit
+    # donate the carried model/optimizer/scaler state (args 0-4): both
+    # nets' params and Adam moments are consumed and re-emitted every
+    # step, and without donation XLA keeps a second copy of each live
+    # (flagged by apex_tpu.analysis's donation rule). The data args
+    # (real, z) are fresh per step and stay undonated.
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
     def step(paramsD, paramsG, stateD, stateG, sstates, real, z):
         s0, s1, s2 = sstates
         # --- D: real + fake backwards, grads accumulated ----------------

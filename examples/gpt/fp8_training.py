@@ -19,6 +19,7 @@ plumbing; fp8-capable chips inherit the speedup unchanged.
 from __future__ import annotations
 
 import argparse
+import functools
 
 
 def parse():
@@ -80,7 +81,11 @@ def main():
         data_key, (args.batch, args.seq), 0, cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
 
-    @jax.jit
+    # donate params + optimizer state (masters/moments updated in place);
+    # the fp8 state tree stays undonated — donating its small nested
+    # buffers trips a TPU backend INVALID_ARGUMENT (see bench.py), and
+    # at KB size copying it is free
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, fp8_states):
         carriers = init_gpt_fp8_carriers(cfg)
 

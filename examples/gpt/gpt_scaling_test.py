@@ -22,6 +22,7 @@ two parse-compatible lines per run, writes ``gpt_scaling.json``, and saves
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import time
@@ -73,7 +74,10 @@ def run_config(cfg_args, layers, cpu_offload):
     opt = FusedAdam(lr=1e-4)
     opt_state = opt.init(params)
 
-    @jax.jit
+    # donate the carried train state: every ladder config re-jits a fresh
+    # step, and an undonated params+moments tree would double each
+    # config's peak memory (apex_tpu.analysis donation rule)
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, tokens, labels))(params)

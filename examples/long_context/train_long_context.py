@@ -18,6 +18,7 @@ chunks ``(r, 2cp-1-r)``).
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import time
 
@@ -101,7 +102,11 @@ def main():
         out_specs=P(), check_vma=True,
     )
 
-    @jax.jit
+    # params + optimizer state are the carried train state: donate them
+    # so the Adam update runs in place instead of XLA copying both trees
+    # every step (the apex_tpu.analysis donation rule flags this);
+    # tokens/labels are reused across steps and must NOT be donated
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(sharded_loss)(
             params, tokens, labels

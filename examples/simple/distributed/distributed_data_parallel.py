@@ -89,12 +89,14 @@ def main():
     pspec = jax.tree_util.tree_map(lambda _: P(), params)
     ospec = jax.tree_util.tree_map(lambda _: P(), opt_state)
     sspec = jax.tree_util.tree_map(lambda _: P(), scaler_state)
+    # donate the carried params/optimizer/scaler state (the data args x/y
+    # are reused every step and must stay undonated)
     step = jax.jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(pspec, ospec, sspec, P("data"), P("data")),
         out_specs=(pspec, ospec, sspec, P()),
         check_vma=True,
-    ))
+    ), donate_argnums=(0, 1, 2))
     x = jax.device_put(x, NamedSharding(mesh, P("data")))
     y = jax.device_put(y, NamedSharding(mesh, P("data")))
 

@@ -88,6 +88,19 @@ def extract_legs(bench: dict) -> Dict[str, float]:
     return out
 
 
+def audit_status(bench: Optional[dict]) -> Optional[dict]:
+    """The static-audit summary a bench capture carries (``"audit"`` in
+    bench.py's output: the headline step traced and checked by
+    ``apex_tpu.analysis`` — see tools/static_audit.py). ``None`` when
+    the capture predates the auditor or skipped it (BENCH_AUDIT=0)."""
+    a = (bench or {}).get("audit")
+    if not isinstance(a, dict):
+        return None
+    return {"ok": a.get("ok"),
+            "error": a.get("error"), "warning": a.get("warning"),
+            "codes": a.get("codes")}
+
+
 def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
     """Leg-by-leg comparison: a leg regresses when it is worse than base
     by more than ``threshold`` (fractional). Legs present on only one
@@ -127,6 +140,19 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
             improvements.append(entry)
         else:
             unchanged.append(leg)
+    # static-audit status alongside the perf legs: a capture whose
+    # headline step STOPPED auditing clean is a regression even when
+    # every throughput number held (the invariant broke, the cost shows
+    # up later / on different hardware)
+    ab, an = audit_status(base), audit_status(new)
+    if an is not None and an.get("ok") is False and (
+            ab is None or ab.get("ok") is not False):
+        regressions.append({
+            "leg": "static_audit",
+            "base": None if ab is None else ab.get("ok"),
+            "new": False,
+            "codes": an.get("codes"),
+        })
     return {
         "threshold_pct": round(100.0 * threshold, 2),
         "regressions": regressions,
@@ -134,6 +160,7 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
         "unchanged": unchanged,
         "only_in_base": sorted(set(a) - set(b)),
         "only_in_new": sorted(set(b) - set(a)),
+        "audit": {"base": ab, "new": an},
     }
 
 

@@ -1,0 +1,213 @@
+"""Static step auditor CLI — trace the repo's own hot paths, gate on findings.
+
+``apex_tpu.analysis`` audits a traced step (jaxpr walk, no execution);
+this tool self-hosts it on the steps the performance story depends on:
+
+- ``gpt_step``         the headline bench configuration in miniature
+                       (bf16 GPT + packed FusedAdam, donated carry);
+- ``packed_adam_step``  the packed FusedAdam sweep (flat fp32 state,
+                       masters, in-place Pallas kernels);
+- ``packed_lamb_step``  the packed FusedLAMB two-stage step;
+- ``telemetry_drain``  the in-jit metrics accumulate + cond-gated async
+                       drain path.
+
+Usage::
+
+    python tools/static_audit.py --self              # table, exit 1 on errors
+    python tools/static_audit.py --self --json       # machine-readable
+    python tools/static_audit.py --self --target gpt_step
+    python tools/static_audit.py --self --fail-on warning
+
+Exit codes (CI contract, like ``tools/health_report.py``): 0 = clean at
+the gated severity, 1 = findings at/above it, 2 = infra/usage error. The
+JSON output is deterministic (sorted findings, no timestamps) so a
+golden-fixture test pins it (``tests/test_static_audit.py``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# script-mode invocation (`python tools/static_audit.py ...`) puts tools/
+# at sys.path[0]; the repo root must be importable for apex_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# self-audit targets: (fn, args, audit kwargs) builders. Tracing only —
+# tiny configs keep a full CPU run in seconds; the invariants checked
+# (donation, gating, aliasing, alignment) are size-independent.
+# ---------------------------------------------------------------------------
+def build_gpt_step():
+    """The headline bench leg's shape: bf16 GPT, packed FusedAdam with
+    masters, params+state donated, loss carried (bench.py:bench_gpt)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import (
+        GPTConfig, gpt_loss, init_gpt_params,
+    )
+
+    cfg = GPTConfig(
+        num_layers=2, num_attention_heads=4, hidden_size=128,
+        vocab_size=512, max_position_embeddings=128,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16, layer_unroll=-1,
+    )
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16),
+        init_gpt_params(cfg, jax.random.PRNGKey(0)))
+    opt = FusedAdam(lr=1e-4, master_weights=True, packed=True,
+                    packed_interpret=True)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 128), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def train_step(params, opt_state, loss_prev):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss(cfg, p, tokens, labels))(params)
+        params, opt_state = opt.step(grads, opt_state, params)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    return step, (params, opt_state, jnp.float32(0)), {}
+
+
+def _packed_opt_target(opt_cls, **opt_kw):
+    import jax
+    import jax.numpy as jnp
+
+    params = {f"w{i}": jnp.zeros((4096,), jnp.bfloat16) for i in range(4)}
+    grads = {k: jnp.full((4096,), 1e-3, jnp.bfloat16) for k in params}
+    opt = opt_cls(packed=True, packed_interpret=True,
+                  packed_chunk_size=4096, master_weights=True, **opt_kw)
+    state = opt.init(params)
+    step = jax.jit(lambda g, s, p: opt.step(g, s, p), donate_argnums=(1, 2))
+    return step, (grads, state, params), {"min_bytes": 4096}
+
+
+def build_packed_adam_step():
+    """The packed FusedAdam sweep: flat fp32 m/v/masters stepped by the
+    in-place chunked kernel (ops/packed_optimizer.packed_adam_apply)."""
+    from apex_tpu.optimizers import FusedAdam
+
+    return _packed_opt_target(FusedAdam, lr=1e-3)
+
+
+def build_packed_lamb_step():
+    """The packed FusedLAMB two-stage step (stage1 + per-tensor trust
+    ratios via segment_sum + scale_update)."""
+    from apex_tpu.optimizers import FusedLAMB
+
+    return _packed_opt_target(FusedLAMB, lr=1e-3)
+
+
+def build_telemetry_drain():
+    """The sync-free metrics path: on-device accumulate + the async
+    drain that must stay behind lax.cond (telemetry/metrics.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import telemetry
+
+    sink = telemetry.NullRecorder()
+
+    def step(metrics, loss):
+        metrics = telemetry.accumulate(metrics, loss=loss, tokens=256)
+        metrics = telemetry.drain(metrics, sink, every_n=10)
+        return metrics, loss * jnp.float32(0.5)
+
+    jitted = jax.jit(step, donate_argnums=(0,))
+    return jitted, (telemetry.init_metrics(), jnp.float32(0)), {}
+
+
+TARGETS = {
+    "gpt_step": build_gpt_step,
+    "packed_adam_step": build_packed_adam_step,
+    "packed_lamb_step": build_packed_lamb_step,
+    "telemetry_drain": build_telemetry_drain,
+}
+
+
+def run_self_audit(targets=None, rules=None):
+    """Audit every (selected) self-target; returns the stable result dict."""
+    from apex_tpu import analysis
+
+    names = list(targets) if targets else sorted(TARGETS)
+    out = {"event": "static_audit", "targets": {}}
+    ok = True
+    for name in names:
+        fn, args, kw = TARGETS[name]()
+        if rules:
+            kw = dict(kw, rules=rules)
+        report = analysis.audit_step(fn, *args, name=name, **kw)
+        out["targets"][name] = report.to_dict()
+        ok = ok and report.ok
+    out["ok"] = ok
+    return out
+
+
+def summarize(result: dict) -> dict:
+    """The one-line summary bench.py/compare_bench.py embed: counts per
+    severity plus the distinct finding codes (stable, sorted)."""
+    counts = {"error": 0, "warning": 0, "info": 0}
+    codes = set()
+    for t in result["targets"].values():
+        for sev, n in t["counts"].items():
+            counts[sev] += n
+        codes.update(f["code"] for f in t["findings"])
+    return {"ok": result["ok"], **counts, "codes": sorted(codes)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Static jaxpr audit of apex_tpu's own training steps")
+    ap.add_argument("--self", action="store_true", dest="self_audit",
+                    help="audit the repo's headline steps (required mode)")
+    ap.add_argument("--target", action="append", choices=sorted(TARGETS),
+                    help="restrict to specific target(s)")
+    ap.add_argument("--rules", help="comma-separated rule subset "
+                                    "(default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full result as JSON")
+    ap.add_argument("--fail-on", choices=["error", "warning"],
+                    default="error",
+                    help="exit non-zero at this severity (default error)")
+    args = ap.parse_args(argv)
+    if not args.self_audit:
+        ap.error("nothing to do: pass --self (audit the repo's own steps)")
+
+    rules = tuple(r for r in (args.rules or "").split(",") if r) or None
+    try:
+        result = run_self_audit(targets=args.target, rules=rules)
+    except Exception as e:  # infra failure must not read as "clean"
+        print(f"static audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        from apex_tpu.analysis import AuditReport, Finding
+
+        for name, t in result["targets"].items():
+            rep = AuditReport(name, [
+                Finding(f["rule"], f["code"], f["severity"], f["message"],
+                        f.get("where", ""), f.get("data"))
+                for f in t["findings"]], tuple(t["rules_run"]))
+            print(rep.table())
+            print()
+        print("summary:", json.dumps(summarize(result)))
+
+    gate = {"error": ("error",), "warning": ("error", "warning")}[args.fail_on]
+    bad = sum(t["counts"][s] for t in result["targets"].values()
+              for s in gate)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
