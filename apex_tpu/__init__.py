@@ -22,6 +22,9 @@ import surface):
 - ``apex_tpu.telemetry``         training-run observability (in-jit metrics,
                                  JSONL/ring sinks, trace sessions, pipeline
                                  bubble accounting)
+- ``apex_tpu.resilience``        fault tolerance (preemption-safe async
+                                 checkpointing, last-good rewind, hang
+                                 watchdog, fault-injection harness)
 """
 import logging
 import sys
@@ -90,6 +93,7 @@ _LAZY_SUBMODULES = (
     "RNN",
     "checkpoint",
     "telemetry",
+    "resilience",
 )
 
 

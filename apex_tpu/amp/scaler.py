@@ -39,6 +39,7 @@ class LossScaleState(NamedTuple):
     unskipped: jax.Array  # i32 scalar, clean steps since last scale change
     hysteresis: jax.Array  # i32 scalar, overflow allowance remaining
     found_inf: jax.Array  # bool scalar, overflow seen in the current step
+    consecutive_skips: jax.Array  # i32 scalar, skipped steps in a row
 
 
 class LossScaler:
@@ -79,6 +80,7 @@ class LossScaler:
             unskipped=jnp.int32(0),
             hysteresis=jnp.int32(self.hysteresis),
             found_inf=jnp.asarray(False),
+            consecutive_skips=jnp.int32(0),
         )
 
     # -- step-time ops (pure, jittable) ------------------------------------
@@ -161,12 +163,21 @@ class LossScaler:
 
             out += (numerics_scale_update(
                 numerics, state.found_inf, state.loss_scale,
-                new_state.loss_scale),)
+                new_state.loss_scale,
+                consecutive_skips=new_state.consecutive_skips),)
         return out if len(out) > 1 else new_state
 
     def _update_scale(self, state: LossScaleState) -> LossScaleState:
+        # consecutive-skip run length: the death-spiral tell. A single
+        # clean step resets it; persistent non-finite grads (a poisoned
+        # data window that outlives hysteresis) grow it without bound —
+        # the resilience rewind trigger and the numerics engine's
+        # edge-triggered ``scaler_stall`` rule both read this counter.
+        consec = jnp.where(
+            state.found_inf, state.consecutive_skips + 1, jnp.int32(0))
         if not self.dynamic:
-            return state._replace(found_inf=jnp.asarray(False))
+            return state._replace(
+                found_inf=jnp.asarray(False), consecutive_skips=consec)
         scale, unskipped, hyst = update_scale_hysteresis(
             state.loss_scale,
             state.unskipped,
@@ -181,7 +192,8 @@ class LossScaler:
         if self.min_loss_scale is not None:
             scale = jnp.maximum(scale, self.min_loss_scale)
         return LossScaleState(
-            loss_scale=scale, unskipped=unskipped, hysteresis=hyst, found_inf=jnp.asarray(False)
+            loss_scale=scale, unskipped=unskipped, hysteresis=hyst,
+            found_inf=jnp.asarray(False), consecutive_skips=consec,
         )
 
     def loss_scale(self, state: LossScaleState) -> jax.Array:
@@ -193,6 +205,8 @@ class LossScaler:
             "loss_scale": float(jax.device_get(state.loss_scale)),
             "unskipped": int(jax.device_get(state.unskipped)),
             "hysteresis": int(jax.device_get(state.hysteresis)),
+            "consecutive_skips": int(
+                jax.device_get(state.consecutive_skips)),
             "dynamic": self.dynamic,
         }
 
@@ -202,4 +216,5 @@ class LossScaler:
             unskipped=jnp.int32(sd.get("unskipped", 0)),
             hysteresis=jnp.int32(sd.get("hysteresis", self.hysteresis)),
             found_inf=jnp.asarray(False),
+            consecutive_skips=jnp.int32(sd.get("consecutive_skips", 0)),
         )

@@ -25,10 +25,22 @@ API::
 
 ``amp.AmpState``/scaler states and the fused optimizers' NamedTuple states
 are plain pytrees — they round-trip as-is.
+
+Durability (the ``apex_tpu.resilience`` contract): ``save_checkpoint``
+stages the write into a same-directory ``<path>.tmp-<pid>`` and renames
+into place only after the checkpointer has fully committed, so a crash or
+preemption mid-write can never leave a half-written tree AT the final
+path — whatever was at ``path`` before the save stays loadable.
+``load_checkpoint`` converts storage-level failures (truncated
+tensorstore files, missing arrays, a checkpoint that never committed)
+into the typed :class:`CheckpointCorruptError`, which
+``resilience.CheckpointManager`` catches to fall back to the newest good
+step instead of dying on an orbax traceback.
 """
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Optional
 
 import jax
@@ -37,24 +49,118 @@ import numpy as np
 Pytree = Any
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint exists at ``path`` but cannot be restored.
+
+    Raised by :func:`load_checkpoint` for storage-level failures —
+    truncated or missing tensorstore files, a partially-deleted tree, a
+    write that never committed. The original backend exception rides as
+    ``__cause__``. ``resilience.CheckpointManager.restore`` catches this
+    (and only this) to fall back to an older step.
+    """
+
+    def __init__(self, path: str, cause: Optional[BaseException] = None):
+        self.path = path
+        detail = f": {type(cause).__name__}: {cause}" if cause else ""
+        super().__init__(f"corrupt or unreadable checkpoint at {path}{detail}")
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.StandardCheckpointer()
 
 
-def save_checkpoint(path: str, state: Pytree, *, overwrite: bool = True) -> None:
+def stale_writer(pid: int) -> bool:
+    """True when a ``*.tmp-<pid>`` staging tree cannot still be being
+    written: the pid is our own (a prior call in this process left it
+    behind) or no longer exists. Pids we cannot probe (EPERM: exists,
+    different user) are treated as live. Shared by this module's sweep
+    and ``resilience.CheckpointManager._sweep_stale_tmp`` — only valid
+    for LOCAL pids, which is why sweeping is skipped in multi-process
+    runs."""
+    if pid == os.getpid():
+        return True
+    try:
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False
+
+
+def save_checkpoint(path: str, state: Pytree, *, overwrite: bool = True,
+                    staged: bool = True) -> None:
     """Write a pytree of (possibly sharded) arrays/scalars to ``path``.
 
     Sharded ``jax.Array`` leaves are written shard-by-shard (every process
     writes only its addressable shards — the reference's v2 sharded format,
     ``distributed_fused_adam.py:3339+``); replicated and host values are
     written once.
+
+    The write is atomic at the directory level **in single-process
+    runs**: it lands in ``<path>.tmp-<pid>`` and is renamed over
+    ``path`` only once complete (same filesystem, so the rename itself
+    is atomic). On any failure the partial tmp tree is removed and
+    whatever previously lived at ``path`` is untouched. Multi-process
+    runs hand orbax the final path directly — every process must agree
+    on ONE directory for its shards and the commit is coordinated by
+    orbax's own finalization; a per-process tmp+rename would scatter
+    shards across private directories (and local pid liveness means
+    nothing across hosts, so no tmp sweeping happens there either).
+
+    ``staged=False`` skips the tmp+rename+stale-sweep entirely: for
+    callers whose ``path`` already sits inside their OWN uncommitted
+    staging directory (``resilience.CheckpointManager._write`` renames a
+    whole ``step_X.tmp-<pid>`` tree at commit), an inner staging layer
+    would be pure overhead and a second copy of the sweep/rename
+    invariants to keep consistent.
     """
+    import glob
+    import re
+
     path = os.path.abspath(path)
+    if not overwrite and os.path.exists(path):
+        # fail BEFORE staging the (potentially many-GB) write
+        raise FileExistsError(
+            f"checkpoint exists at {path} and overwrite=False")
     ckptr = _checkpointer()
-    ckptr.save(path, state, force=overwrite)
-    ckptr.wait_until_finished()
+    if not staged or jax.process_count() > 1:
+        ckptr.save(path, state, force=overwrite)
+        ckptr.wait_until_finished()
+        return
+    tmp = f"{path}.tmp-{os.getpid()}"
+    # sweep stale partials — ours, and any whose writer pid is dead (a
+    # crashed previous process leaves its full-size tmp behind with a
+    # DIFFERENT pid in the name; without this, crash/restart cycles
+    # leak one state-size tree each)
+    for stale in glob.glob(glob.escape(path) + ".tmp-*"):
+        # matches both our staging dirs (<path>.tmp-<pid>) and orbax's
+        # own staging siblings (<path>.tmp-<pid>.orbax-checkpoint-tmp-N)
+        m = re.search(r"\.tmp-(\d+)", os.path.basename(stale))
+        if m is not None and stale_writer(int(m.group(1))):
+            shutil.rmtree(stale, ignore_errors=True)
+    try:
+        ckptr.save(tmp, state, force=True)
+        ckptr.wait_until_finished()
+    except BaseException:
+        # orbax stages into its own `<tmp>.orbax-checkpoint-tmp-*`
+        # sibling before finalizing; sweep both on failure
+        for leftover in [tmp] + glob.glob(
+                glob.escape(tmp) + ".orbax-checkpoint-tmp-*"):
+            shutil.rmtree(leftover, ignore_errors=True)
+        raise
+    if os.path.exists(path):
+        if not overwrite:  # appeared during the write
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise FileExistsError(
+                f"checkpoint exists at {path} and overwrite=False")
+        # the only non-atomic window: the old tree is dropped before the
+        # new one is renamed in. resilience.CheckpointManager never
+        # overwrites (one directory per step), so it has no such window.
+        shutil.rmtree(path)
+    os.rename(tmp, path)
 
 
 def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
@@ -67,11 +173,21 @@ def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
     places shards directly on the right devices, including onto a
     *different* mesh than the one that saved (the v1 format's
     gather/rescatter capability without the gather).
+
+    Raises :class:`FileNotFoundError` when nothing exists at ``path`` and
+    :class:`CheckpointCorruptError` when something does but the restore
+    fails at the storage layer (truncated files, missing arrays, an
+    uncommitted write).
     """
     path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no checkpoint at {path}")
     ckptr = _checkpointer()
     if target is None:
-        return ckptr.restore(path)
+        try:
+            return ckptr.restore(path)
+        except Exception as e:
+            raise CheckpointCorruptError(path, e) from e
 
     def to_abstract(leaf):
         if isinstance(leaf, jax.ShapeDtypeStruct):
@@ -85,4 +201,11 @@ def load_checkpoint(path: str, target: Optional[Pytree] = None) -> Pytree:
         return leaf  # scalars and strings restore as saved
 
     abstract = jax.tree_util.tree_map(to_abstract, target)
-    return ckptr.restore(path, abstract)
+    try:
+        return ckptr.restore(path, abstract)
+    except Exception as e:
+        # truncated tensorstore files surface as ValueError/OSError deep
+        # inside the backend — indistinguishable by type from a bad
+        # target template, so everything is wrapped; the original rides
+        # as __cause__ for triage
+        raise CheckpointCorruptError(path, e) from e

@@ -1,0 +1,77 @@
+"""apex_tpu.resilience: keep training through the failures the monitors see.
+
+PRs 2–4 built the observability half of production training (in-jit
+telemetry, numerics provenance, static step audits); this package is the
+response half — the run must *survive* what they detect:
+
+- :mod:`~apex_tpu.resilience.manager` — preemption-safe
+  :class:`CheckpointManager`: atomic step directories (tmp + rename),
+  ``keep_n`` retention + GC, async saves barriered at the next save,
+  corrupted-checkpoint fallback on restore, SIGTERM emergency flush;
+- :mod:`~apex_tpu.resilience.state` — :class:`TrainState`
+  capture/restore (params, packed or pytree optimizer state, scaler,
+  RNG, data-iterator position, telemetry counters) and the
+  :func:`resume_or_init` one-liner; resumed runs continue the loss
+  curve bit-exactly on CPU/interpret;
+- :mod:`~apex_tpu.resilience.rewind` — :class:`RewindController`: a
+  host ring of the last K good states, triggered by the PR-3 anomaly
+  engine (``scaler_stall`` / ``scale_collapse``) or the scaler's
+  consecutive-skip counter; rewinds past poisoned data windows;
+- :mod:`~apex_tpu.resilience.watchdog` — :class:`HangWatchdog`: bounded
+  blocking points with all-thread stack dumps instead of silent pod
+  deadlocks;
+- :mod:`~apex_tpu.resilience.retry` — the jittered-backoff
+  :class:`RetryPolicy` (promoted from bench.py) used by checkpoint IO
+  and the bench legs;
+- :mod:`~apex_tpu.resilience.chaos` — fault injection (NaN gradients,
+  failed/truncated checkpoint writes, fake preemption, stalled
+  callbacks) driving the tests and ``tools/resilience_check.py --self``.
+
+See ``docs/resilience.md`` for the end-to-end story.
+"""
+from .chaos import (  # noqa: F401
+    ChaosError,
+    ChaosMonkey,
+    StallingSink,
+    corrupt_checkpoint,
+    poison_grads,
+    send_preemption,
+)
+from .manager import (  # noqa: F401
+    CHECKPOINT_IO_POLICY,
+    CheckpointManager,
+    PreemptionError,
+)
+from .retry import (  # noqa: F401
+    TRANSIENT_COMPILE_POLICY,
+    RetryPolicy,
+    retry_call,
+)
+from .rewind import (  # noqa: F401
+    RewindController,
+    RewindExhaustedError,
+)
+from .state import (  # noqa: F401
+    IndexedBatches,
+    ResumableIterator,
+    TrainState,
+    capture,
+    host_snapshot,
+    resume_or_init,
+)
+from .watchdog import (  # noqa: F401
+    HangError,
+    HangWatchdog,
+    dump_all_stacks,
+)
+
+__all__ = [
+    "CHECKPOINT_IO_POLICY", "CheckpointManager", "PreemptionError",
+    "TRANSIENT_COMPILE_POLICY", "RetryPolicy", "retry_call",
+    "RewindController", "RewindExhaustedError",
+    "IndexedBatches", "ResumableIterator", "TrainState", "capture",
+    "host_snapshot", "resume_or_init",
+    "HangError", "HangWatchdog", "dump_all_stacks",
+    "ChaosError", "ChaosMonkey", "StallingSink", "corrupt_checkpoint",
+    "poison_grads", "send_preemption",
+]

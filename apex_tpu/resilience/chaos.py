@@ -1,0 +1,168 @@
+"""Fault injection: break the stack on purpose, prove it survives.
+
+Every resilience claim in this package is only as good as the failure it
+was tested against, so the chaos layer reaches into each seam the
+subsystem defends:
+
+- **Poisoned data** — :meth:`ChaosMonkey.poison_batches` marks a window
+  of batch indices; the loop asks :meth:`should_poison` per batch (a
+  host bool fed into the jitted step) and :func:`poison_grads` turns it
+  into NaN gradients *in-jit*. Keyed by batch index, not step — after a
+  rewind advances the iterator past the window, the poison is gone,
+  exactly like a corrupt data shard.
+- **Checkpoint write faults** — :meth:`fail_write_at` /
+  :meth:`fail_commit_at` make the manager's background write raise
+  before the array write or between write and commit (the atomicity
+  window); :func:`corrupt_checkpoint` truncates a committed step's
+  storage post-hoc (the bit-rot / partial-delete case the restore
+  fallback must survive).
+- **Preemption** — :func:`send_preemption` delivers a real SIGTERM to
+  the current process, driving the manager's emergency-flush handler.
+- **Stalls** — :class:`StallingSink` blocks inside a recorder callback
+  (the shape of a wedged host callback / storage write) so the watchdog
+  has something real to catch.
+
+Used by ``tests/test_resilience.py``, ``tests/test_crash_resume.py``
+and the CI smoke ``tools/resilience_check.py --self``.
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import signal
+import threading
+import time
+from typing import Iterable, Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+Pytree = object
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (never raised by production code paths)."""
+
+
+class ChaosMonkey:
+    """Injection flags consulted by the resilience seams.
+
+    Pass an instance as ``CheckpointManager(chaos=...)``; checkpoint
+    faults arm per step and fire once.
+    """
+
+    def __init__(self):
+        self._fail_write: Set[int] = set()
+        self._fail_commit: Set[int] = set()
+        self._poison: Set[int] = set()
+        self.faults_fired: list = []
+
+    # -- checkpoint seams (called by CheckpointManager._write) -------------
+    def fail_write_at(self, *steps: int) -> "ChaosMonkey":
+        """Fail the save BEFORE the array tree is written."""
+        self._fail_write.update(int(s) for s in steps)
+        return self
+
+    def fail_commit_at(self, *steps: int) -> "ChaosMonkey":
+        """Fail AFTER the tmp tree is fully written, BEFORE the rename —
+        the exact window atomicity must cover."""
+        self._fail_commit.update(int(s) for s in steps)
+        return self
+
+    def before_write(self, step: int) -> None:
+        if int(step) in self._fail_write:
+            self._fail_write.discard(int(step))
+            self.faults_fired.append(("write", int(step)))
+            raise ChaosError(f"injected write failure at step {step}")
+
+    def before_commit(self, step: int) -> None:
+        if int(step) in self._fail_commit:
+            self._fail_commit.discard(int(step))
+            self.faults_fired.append(("commit", int(step)))
+            raise ChaosError(f"injected commit failure at step {step}")
+
+    # -- data poisoning ----------------------------------------------------
+    def poison_batches(self, batches: Iterable[int]) -> "ChaosMonkey":
+        """Mark batch indices whose gradients go NaN (a corrupt shard)."""
+        self._poison.update(int(b) for b in batches)
+        return self
+
+    def should_poison(self, batch_index: int) -> bool:
+        return int(batch_index) in self._poison
+
+
+def poison_grads(grads: Pytree, poison) -> Pytree:
+    """In-jit NaN injection: multiply every gradient leaf by NaN when
+    ``poison`` (a traced bool — the host feeds ``chaos.should_poison(i)``
+    in as a step argument, so one compiled step serves both arms)."""
+    flag = jnp.asarray(poison, jnp.bool_)
+
+    def bad(g):
+        mult = jnp.where(flag, jnp.float32(jnp.nan), jnp.float32(1.0))
+        return g * mult.astype(g.dtype)
+
+    return jax.tree_util.tree_map(bad, grads)
+
+
+def corrupt_checkpoint(step_dir: str, *, truncate_to: int = 4,
+                       only_largest: bool = False) -> list:
+    """Truncate the storage files of a COMMITTED checkpoint — post-hoc
+    bit-rot the restore fallback must detect and skip.
+
+    Default damages every file (the unambiguous total-rot case;
+    tensorstore's ocdbt layout inlines small arrays into manifests, so a
+    single damaged data file may be survivable — which is fine for real
+    rot but useless for a determinism-needing test).
+    ``only_largest=True`` clips just the biggest file (the
+    single-bad-sector case). Returns the damaged paths."""
+    root = pathlib.Path(step_dir)
+    files = [f for f in root.rglob("*") if f.is_file()]
+    if not files:
+        raise FileNotFoundError(f"no files under {step_dir}")
+    if only_largest:
+        files = [max(files, key=lambda f: f.stat().st_size)]
+    for victim in files:
+        with open(victim, "r+b") as f:
+            f.truncate(truncate_to)
+    return [str(f) for f in files]
+
+
+def send_preemption(sig: int = signal.SIGTERM) -> None:
+    """Deliver a real preemption notice to this process (the cloud
+    SIGTERM), driving any installed emergency-flush handler."""
+    os.kill(os.getpid(), sig)
+
+
+class StallingSink:
+    """A recorder whose ``record`` blocks — the wedged-callback fault.
+
+    ``stall_s`` bounds the stall (so an un-watched test cannot hang
+    forever); ``release`` frees it early. ``forward`` optionally passes
+    records through to a real sink after the stall.
+    """
+
+    def __init__(self, stall_s: float = 30.0, *, forward=None):
+        self.stall_s = float(stall_s)
+        self.forward = forward
+        self.stalled = threading.Event()   # set while a record is stuck
+        self._release = threading.Event()
+        self.records: list = []
+
+    def record(self, rec: dict) -> None:
+        self.stalled.set()
+        self._release.wait(self.stall_s)
+        self.records.append(dict(rec))
+        if self.forward is not None:
+            self.forward.record(rec)
+
+    def add_scalar(self, name, value, step) -> None:
+        self.record({"event": "scalar", "name": name, "value": value,
+                     "step": step})
+
+    def release(self) -> None:
+        self._release.set()
+
+
+def stall(seconds: float) -> None:
+    """A plain host stall (for wrapping into callbacks under test)."""
+    time.sleep(float(seconds))

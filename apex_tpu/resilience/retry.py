@@ -1,0 +1,139 @@
+"""Jittered-exponential-backoff retry — the one transient-failure policy.
+
+Promoted from ``bench.py``'s ``_retry_transient`` (which retried the axon
+remote-compile transport flakes with a fixed attempt count and no
+backoff): a :class:`RetryPolicy` names *which* exceptions are transient —
+per-exception-class filters plus an optional message predicate — and how
+to pace the re-attempts (exponential backoff with full jitter, the
+standard thundering-herd-safe schedule). Every attempt can be mirrored
+into telemetry (``{"event": "retry", ...}`` through any recorder sink),
+so flaky infrastructure shows up in the run's JSONL instead of only on
+stderr.
+
+Consumers: ``bench.py`` legs (compile-transport flakes) and
+``resilience.CheckpointManager`` IO (storage blips during save/GC).
+
+Usage::
+
+    from apex_tpu.resilience import RetryPolicy, retry_call
+
+    policy = RetryPolicy(attempts=4, retry_on=(OSError,), base_delay=0.1)
+    result = retry_call(fn, policy=policy, tag="ckpt write", sink=rec)
+"""
+from __future__ import annotations
+
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, Type
+
+
+def as_record(sink):
+    """Coerce a telemetry sink to a ``callable(dict)``: recorders expose
+    ``.record``, bare callables pass through, ``None`` stays ``None``.
+    The one sink-contract shim for the whole resilience package."""
+    if sink is None:
+        return None
+    return sink.record if hasattr(sink, "record") else sink
+
+
+def _transient_compile_transport(e: BaseException) -> bool:
+    """bench.py's historical filter: the axon remote-compile transport
+    flaking mid-compile (HTTP 500 / 'response body closed' — observed
+    ~1/20 legs on long runs). Real failures (OOM, invalid argument) do
+    not match and surface immediately."""
+    msg = str(e)
+    return "remote_compile" in msg and (
+        "response body closed" in msg or "HTTP 500" in msg
+        or "read body" in msg
+    )
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """What to retry and how to pace it.
+
+    - ``attempts``: total tries (first call included).
+    - ``retry_on``: exception classes considered transient. An exception
+      not matching any class surfaces immediately.
+    - ``message_filter``: optional extra predicate over the exception —
+      both the class match AND the predicate must hold (used to narrow
+      e.g. ``Exception`` to a known transport signature).
+    - ``base_delay``/``max_delay``: exponential backoff bounds in
+      seconds; attempt *k* sleeps ``uniform(0, min(max_delay, base_delay
+      * 2**k))`` — "full jitter", so a fleet of preempted workers does
+      not re-stampede the storage service in lockstep. ``base_delay=0``
+      disables sleeping (the historical bench behaviour).
+    """
+
+    attempts: int = 3
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+    message_filter: Optional[Callable[[BaseException], bool]] = None
+    base_delay: float = 0.0
+    max_delay: float = 30.0
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    def is_transient(self, e: BaseException) -> bool:
+        if not isinstance(e, self.retry_on):
+            return False
+        return self.message_filter is None or bool(self.message_filter(e))
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before re-attempt number ``attempt`` (1-based)."""
+        if self.base_delay <= 0:
+            return 0.0
+        cap = min(self.max_delay, self.base_delay * (2.0 ** (attempt - 1)))
+        return self.rng.uniform(0.0, cap)
+
+
+#: bench.py's policy, importable by name: transport-flake filter, no
+#: backoff sleep (a failed compile already burned seconds; re-dialing
+#: immediately is fine for a single host).
+TRANSIENT_COMPILE_POLICY = RetryPolicy(
+    attempts=3,
+    retry_on=(Exception,),
+    message_filter=_transient_compile_transport,
+)
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    policy: RetryPolicy = TRANSIENT_COMPILE_POLICY,
+    tag: str = "call",
+    sink=None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run ``fn()`` under ``policy``; return its result.
+
+    Each failed transient attempt emits ``{"event": "retry", "tag",
+    "attempt", "of", "error", "delay_s"}`` to ``sink`` (a recorder with
+    ``.record(dict)`` or a bare callable; ``None`` logs to stderr only)
+    and sleeps the policy's jittered backoff. The final attempt's
+    exception — or any non-transient one — propagates unchanged.
+    """
+    record = as_record(sink)
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.attempts + 1):
+        try:
+            return fn()
+        except BaseException as e:
+            last = e
+            if not policy.is_transient(e) or attempt == policy.attempts:
+                raise
+            d = policy.delay(attempt)
+            print(
+                f"{tag}: transient {type(e).__name__}, retrying "
+                f"(attempt {attempt + 1}/{policy.attempts}"
+                + (f", backoff {d:.2f}s" if d else "") + ")",
+                file=sys.stderr,
+            )
+            if record is not None:
+                record({"event": "retry", "tag": tag,
+                        "attempt": attempt, "of": policy.attempts,
+                        "error": f"{type(e).__name__}: {e}",
+                        "delay_s": round(d, 3)})
+            if d:
+                sleep(d)
+    raise last  # unreachable; keeps type-checkers honest

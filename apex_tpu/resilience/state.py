@@ -1,0 +1,205 @@
+"""Resumable training state: one container for everything a restart needs.
+
+The reference checkpoints model+optimizer state and leaves the rest of
+the training loop — scaler counters, RNG stream, where the data iterator
+was — to the launcher scripts, which is exactly the state a preempted
+run needs to continue *bit-exactly*. :class:`TrainState` names all of
+it:
+
+- ``step``        host int, the loop's step counter;
+- ``params``      model parameters (any pytree);
+- ``opt_state``   pytree or packed (:class:`~apex_tpu.optimizers._packed.
+  PackedState` — the flat buffers checkpoint as plain arrays, the static
+  :class:`PackSpec` rides the restore template);
+- ``scaler``      :class:`~apex_tpu.amp.scaler.LossScaleState` or None;
+- ``rng``         the loop's PRNG key (uint32 ``jax.random.PRNGKey``
+  form — typed keys from ``jax.random.key`` should be converted with
+  ``jax.random.key_data`` before capture);
+- ``data``        host-side, JSON-serializable data-iterator state (see
+  :class:`ResumableIterator`) — stored in the checkpoint's ``meta.json``,
+  not the array tree;
+- ``metrics`` / ``numerics`` — the PR-2/PR-3 telemetry states, so
+  cumulative counters (overflow skips, scale growths, first-bad-step)
+  survive a restart instead of silently resetting.
+
+``resume_or_init(manager, init_fn)`` is the loop's one-liner entry:
+restore the newest good checkpoint if one exists, else initialize fresh.
+A resumed run replays the loss curve of an uninterrupted one bit-exactly
+on CPU/interpret backends (``tests/test_crash_resume.py`` pins this).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+class TrainState(NamedTuple):
+    """Everything a training loop needs to continue after a restart."""
+
+    step: int
+    params: Pytree
+    opt_state: Any = None
+    scaler: Any = None
+    rng: Optional[jax.Array] = None
+    data: Any = None
+    metrics: Any = None
+    numerics: Any = None
+
+
+def capture(
+    step,
+    params: Pytree,
+    opt_state: Any = None,
+    *,
+    scaler: Any = None,
+    rng: Optional[jax.Array] = None,
+    data: Any = None,
+    metrics: Any = None,
+    numerics: Any = None,
+) -> TrainState:
+    """Assemble a :class:`TrainState` (coercing ``step`` to a host int)."""
+    return TrainState(
+        step=int(step), params=params, opt_state=opt_state, scaler=scaler,
+        rng=rng, data=data, metrics=metrics, numerics=numerics,
+    )
+
+
+def device_part(state: TrainState) -> Tuple:
+    """The array-bearing fields, in checkpoint order (``step`` and
+    ``data`` are host-side and live in the checkpoint's ``meta.json``)."""
+    return (state.params, state.opt_state, state.scaler, state.rng,
+            state.metrics, state.numerics)
+
+
+def host_snapshot(tree: Pytree) -> Pytree:
+    """A donation-safe deep host copy of every array leaf.
+
+    ``np.array(..., copy=True)`` blocks until each leaf's value is
+    computed and then owns fresh host memory — no view into a device
+    buffer survives, so the original arrays may be donated into the next
+    jitted step (or deleted) immediately after this returns. For a
+    packed optimizer this is cheap by construction: the whole state is a
+    handful of contiguous flat buffers, one memcpy each.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True), tree)
+
+
+def flat_leaves(tree: Pytree) -> dict:
+    """Flatten to the on-disk form: a dict of zero-padded leaf indices.
+
+    Sidesteps every custom-pytree-node serialization question (packed
+    states, NamedTuples, None fields): only raw array leaves are stored;
+    the structure comes back from the restore template.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    return {f"{i:05d}": leaf for i, leaf in enumerate(leaves)}
+
+
+def unflatten_like(template: Pytree, flat: dict) -> Pytree:
+    """Rebuild ``template``'s structure from :func:`flat_leaves` output."""
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(flat) != len(t_leaves):
+        raise ValueError(
+            f"checkpoint has {len(flat)} leaves, template expects "
+            f"{len(t_leaves)} — the run's state structure changed")
+    leaves = [flat[f"{i:05d}"] for i in range(len(t_leaves))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def resume_or_init(
+    manager,
+    init_fn: Callable[[], TrainState],
+    *,
+    step: Optional[int] = None,
+) -> Tuple[TrainState, bool]:
+    """Restore the newest good checkpoint, else initialize fresh.
+
+    ``init_fn`` builds the step-0 :class:`TrainState`; its structure is
+    the restore template (dtypes/shapes/shardings must match the saved
+    run). Returns ``(state, resumed)``. Corrupted or partial checkpoints
+    are skipped automatically (the manager falls back to the newest good
+    step and emits a ``checkpoint_fallback`` event per bad one); if
+    checkpoints exist but EVERY one fails to load, the manager raises
+    rather than silently restarting the run from step 0.
+    """
+    template = init_fn()
+    restored = manager.restore(template, step=step)
+    if restored is None:
+        return template, False
+    return restored, True
+
+
+# ---------------------------------------------------------------------------
+# resumable data iteration
+# ---------------------------------------------------------------------------
+
+
+class ResumableIterator:
+    """A position-checkpointable wrapper over a deterministic batch stream.
+
+    ``factory()`` returns a fresh iterator over the epoch's batches; this
+    wrapper counts consumption so :meth:`state` / :meth:`restore` can
+    round-trip the position through a checkpoint's ``meta.json``. Restore
+    re-creates the stream and drains ``position`` items — O(position),
+    correct for any iterator. :class:`IndexedBatches` gives O(1) seek
+    when batches are addressable by index (the common synthetic / memory-
+    mapped case).
+
+    :meth:`skip` advances without yielding — the rewind path uses it to
+    jump the stream past a poisoned window.
+    """
+
+    def __init__(self, factory: Callable[[], Any], *, position: int = 0):
+        self._factory = factory
+        self._it = iter(factory())
+        self.position = 0
+        if position:
+            self.skip(position)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._it)
+        self.position += 1
+        return batch
+
+    def skip(self, n: int) -> None:
+        """Advance ``n`` batches without returning them."""
+        for _ in range(int(n)):
+            next(self._it)
+            self.position += 1
+
+    def state(self) -> dict:
+        return {"position": int(self.position)}
+
+    def restore(self, state: dict) -> None:
+        """Reset to a fresh stream and seek to the saved position."""
+        self._it = iter(self._factory())
+        self.position = 0
+        self.skip(int(state["position"]))
+
+
+class IndexedBatches(ResumableIterator):
+    """Random-access batches: ``fn(i)`` produces batch ``i`` — seek is
+    O(1), so restore and rewind-skip cost nothing."""
+
+    def __init__(self, fn: Callable[[int], Any], *, position: int = 0):
+        self._fn = fn
+        self.position = int(position)
+
+    def __next__(self):
+        batch = self._fn(self.position)
+        self.position += 1
+        return batch
+
+    def skip(self, n: int) -> None:
+        self.position += int(n)
+
+    def restore(self, state: dict) -> None:
+        self.position = int(state["position"])

@@ -30,7 +30,9 @@ drains, recorder sinks with rank-0 gating):
   steps the cond is not taken and the host does nothing):
   ``nonfinite_grads`` (with the guilty leaves), ``grad_spike`` (norm vs
   an EWMA window), ``scale_collapse`` (loss scale crossing below a
-  floor, edge-triggered).
+  floor, edge-triggered), ``scaler_stall`` (the scaler's
+  consecutive-skip counter crossing ``max_consecutive_skips``,
+  edge-triggered — the ``apex_tpu.resilience`` rewind trigger).
 - **Activation watch** — opt-in :func:`tap` points keyed by the named
   scopes on the transformer layers and packed kernels; identity (zero
   cost, no trace difference) unless an :func:`activation_watch` context
@@ -143,19 +145,30 @@ class NumericsState(NamedTuple):
     loss_scale: jax.Array      # f32, last scale from observe_scale_update
     prev_loss_scale: jax.Array  # f32, the scale before that update
     first_bad_step: jax.Array  # i32, first overflow step (-1 = never)
+    consecutive_skips: jax.Array       # i32, scaler skip-run length
+    prev_consecutive_skips: jax.Array  # i32, the run length before that
 
 
 def observe_scale_update(
-    state: NumericsState, found_inf, old_scale, new_scale
+    state: NumericsState, found_inf, old_scale, new_scale,
+    consecutive_skips=None,
 ) -> NumericsState:
     """Fold one loss-scale update into the numerics state (pure, in-jit).
 
     Called by :meth:`apex_tpu.amp.LossScaler.update_scale` when given
     ``numerics=``: the consumed ``found_inf`` marks the step overflowed
-    (first-bad-step latches), and the old/new scales feed the
-    edge-triggered ``scale_collapse`` rule evaluated at drain.
+    (first-bad-step latches), the old/new scales feed the edge-triggered
+    ``scale_collapse`` rule, and the scaler's post-update
+    ``consecutive_skips`` counter feeds the edge-triggered
+    ``scaler_stall`` rule (both evaluated at drain).
     """
     overflow = state.overflow | jnp.asarray(found_inf, jnp.bool_)
+    if consecutive_skips is None:
+        # legacy caller: derive the run length from the overflow flags
+        # this state has seen (reset on a clean update)
+        consecutive_skips = jnp.where(
+            jnp.asarray(found_inf, jnp.bool_),
+            state.consecutive_skips + 1, jnp.int32(0))
     return state._replace(
         overflow=overflow,
         first_bad_step=jnp.where(
@@ -163,6 +176,8 @@ def observe_scale_update(
             state.step, state.first_bad_step),
         prev_loss_scale=jnp.asarray(old_scale, jnp.float32),
         loss_scale=jnp.asarray(new_scale, jnp.float32),
+        prev_consecutive_skips=state.consecutive_skips,
+        consecutive_skips=jnp.asarray(consecutive_skips, jnp.int32),
     )
 
 
@@ -181,6 +196,10 @@ class NumericsMonitor:
       EWMA of previous finite norms, after ``spike_warmup`` finite steps.
     - ``scale_collapse`` — loss scale crossed below ``scale_floor``
       (edge-triggered on the crossing, not re-emitted while low).
+    - ``scaler_stall`` — the scaler's consecutive-skip counter crossed
+      ``max_consecutive_skips`` (edge-triggered): persistent non-finite
+      grads have outlived hysteresis and the scaler is halving forever.
+      This is the ``resilience.RewindController`` trigger.
     """
 
     def __init__(
@@ -192,6 +211,7 @@ class NumericsMonitor:
         spike_factor: float = 10.0,
         spike_warmup: int = 20,
         scale_floor: float = _DEFAULT_SCALE_FLOOR,
+        max_consecutive_skips: int = 8,
         tag: Optional[str] = None,
     ):
         # tolerate NumericsMonitor(pack_spec) — a spec is not a pytree of
@@ -217,6 +237,7 @@ class NumericsMonitor:
         self.spike_factor = float(spike_factor)
         self.spike_warmup = int(spike_warmup)
         self.scale_floor = float(scale_floor)
+        self.max_consecutive_skips = int(max_consecutive_skips)
         self.tag = tag
 
     # -- state -------------------------------------------------------------
@@ -240,6 +261,8 @@ class NumericsMonitor:
             loss_scale=f(),
             prev_loss_scale=f(),
             first_bad_step=jnp.int32(-1),
+            consecutive_skips=i(),
+            prev_consecutive_skips=i(),
         )
 
     # -- observation (pure, in-jit) ----------------------------------------
@@ -411,9 +434,16 @@ class NumericsMonitor:
         collapse = ((state.loss_scale > 0)
                     & (state.loss_scale < floor)
                     & (state.prev_loss_scale >= floor))
+        # edge-triggered: fires on the step the run length CROSSES the
+        # budget, not on every subsequent skipped step — the rewind
+        # controller must see exactly one trigger per stall
+        budget = jnp.int32(self.max_consecutive_skips)
+        stall = ((budget > 0)
+                 & (state.consecutive_skips >= budget)
+                 & (state.prev_consecutive_skips < budget))
 
         def _emit(step, nf, sq, ma, overflow, spike, ratio, norm, ewma,
-                  scale, prev_scale, clps, first_bad):
+                  scale, prev_scale, clps, first_bad, stl, consec):
             base = {"step": int(step), "t_wall": time.time()}
             if tag is not None:
                 base["tag"] = tag
@@ -434,6 +464,14 @@ class NumericsMonitor:
                         "loss_scale": float(scale),
                         "prev_loss_scale": float(prev_scale),
                         "floor": self.scale_floor})
+            if bool(stl):
+                record({**base, "event": "anomaly",
+                        "kind": "scaler_stall",
+                        "consecutive_skips": int(consec),
+                        "max_consecutive_skips":
+                            self.max_consecutive_skips,
+                        "loss_scale": float(scale),
+                        "first_bad_step": int(first_bad)})
 
         def _fire():
             jax.debug.callback(
@@ -441,9 +479,9 @@ class NumericsMonitor:
                 state.grad_maxabs, state.overflow, state.spike,
                 state.spike_ratio, state.grad_norm, state.ewma_norm,
                 state.loss_scale, state.prev_loss_scale, collapse,
-                state.first_bad_step)
+                state.first_bad_step, stall, state.consecutive_skips)
 
-        any_event = state.overflow | state.spike | collapse
+        any_event = state.overflow | state.spike | collapse | stall
         jax.lax.cond(any_event, _fire, lambda: None)
 
         if health_every:

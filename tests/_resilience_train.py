@@ -1,0 +1,168 @@
+"""Subprocess target for the crash/resume integration tests.
+
+A miniature but fully-armed training run: bf16 MLP params, packed
+FusedAdam (flat fp32 buffers + masters, interpret-mode kernels), dynamic
+loss scaler, carried PRNG key (dropout), IndexedBatches data stream, and
+the PR-2/PR-3 telemetry states — everything
+``resilience.TrainState`` claims to make resumable. Each completed step
+appends ``S <step> <loss.hex()>`` to the losses file (bit-exact loss
+records); the end of a full run appends a ``F <total_steps>
+<loss_scale>`` summary line from the telemetry counters.
+
+Modes (driven by tests/test_crash_resume.py):
+
+- plain: run ``--steps`` steps with checkpoints every 3, exit 0;
+- ``--die-at K``: ``os._exit(13)`` immediately after step K's loss line
+  — a hard crash (no cleanup, async save threads killed mid-write);
+- ``--preemptable``: install the SIGTERM emergency-flush handler and
+  exit 17 when preempted (optionally ``--step-sleep`` to give the
+  parent time to deliver the signal).
+
+Every invocation resumes from the newest good checkpoint automatically
+(``resume_or_init``); a fresh root starts from scratch.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu.amp.scaler import LossScaler  # noqa: E402
+from apex_tpu.optimizers import FusedAdam  # noqa: E402
+from apex_tpu.resilience import (  # noqa: E402
+    CheckpointManager, IndexedBatches, capture, resume_or_init,
+)
+from apex_tpu import telemetry  # noqa: E402
+from apex_tpu.telemetry import numerics as tnum  # noqa: E402
+
+N_IN, HID, BATCH = 8, 16, 4
+
+
+def batch_fn(i):
+    k = jax.random.fold_in(jax.random.PRNGKey(1234), i)
+    kx, ky = jax.random.split(k)
+    x = jax.random.normal(kx, (BATCH, N_IN), jnp.float32)
+    y = (jnp.sum(x, axis=1, keepdims=True)
+         + 0.1 * jax.random.normal(ky, (BATCH, 1)))
+    return x, y
+
+
+def init_params():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    return {
+        "w1": (0.3 * jax.random.normal(k1, (N_IN, HID))).astype(jnp.bfloat16),
+        "b1": jnp.zeros((HID,), jnp.bfloat16),
+        "w2": (0.3 * jax.random.normal(k2, (HID, 1))).astype(jnp.bfloat16),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--losses", required=True)
+    ap.add_argument("--die-at", type=int, default=None)
+    ap.add_argument("--preemptable", action="store_true")
+    ap.add_argument("--step-sleep", type=float, default=0.0)
+    ap.add_argument("--save-every", type=int, default=3)
+    args = ap.parse_args()
+
+    opt = FusedAdam(lr=1e-2, packed=True, packed_interpret=True,
+                    packed_chunk_size=256, master_weights=True)
+    sc = LossScaler("dynamic", init_scale=2.0 ** 8, scale_window=5)
+    mon = tnum.NumericsMonitor(init_params(), max_consecutive_skips=4)
+
+    @jax.jit
+    def train_step(params, opt_state, sstate, nstate, metrics, rng, x, y):
+        rng, sub = jax.random.split(rng)
+
+        def loss_fn(p):
+            h = jnp.tanh(x.astype(jnp.bfloat16) @ p["w1"] + p["b1"])
+            keep = jax.random.bernoulli(sub, 0.9, h.shape)
+            h = jnp.where(keep, h, 0).astype(jnp.bfloat16)
+            pred = h @ p["w2"]
+            return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+        def scaled(p):
+            loss = loss_fn(p)
+            return sc.scale_loss(sstate, loss), loss
+
+        (_, loss), grads = jax.value_and_grad(
+            scaled, has_aux=True)(params)
+        grads, new_sstate, nstate = sc.unscale(
+            sstate, grads, numerics=(mon, nstate))
+        params, opt_state = opt.step(
+            grads, opt_state, params, found_inf=new_sstate.found_inf)
+        new_sstate, metrics, nstate = sc.update_scale(
+            new_sstate, metrics=metrics, numerics=nstate)
+        metrics = telemetry.accumulate(metrics, loss=loss, tokens=BATCH)
+        return params, opt_state, new_sstate, nstate, metrics, rng, loss
+
+    def init_state():
+        params = init_params()
+        return capture(
+            0, params, opt.init(params), scaler=sc.init_state(),
+            rng=jax.random.PRNGKey(42), data={"position": 0},
+            metrics=telemetry.init_metrics(), numerics=mon.init())
+
+    mgr = CheckpointManager(args.root, keep_n=2, async_save=True,
+                            save_every=args.save_every)
+    state, resumed = resume_or_init(mgr, init_state)
+    it = IndexedBatches(batch_fn, position=int(state.data["position"]))
+    params = jax.device_put(state.params)
+    opt_state = jax.device_put(state.opt_state)
+    sstate = jax.device_put(state.scaler)
+    nstate = jax.device_put(state.numerics)
+    metrics = jax.device_put(state.metrics)
+    rng = jax.device_put(state.rng)
+    done = int(state.step)
+
+    # seeded BEFORE the handler is armed: a SIGTERM during the first
+    # step's compile must flush the resumed/initial state, not KeyError
+    latest = {"state": capture(
+        done, params, opt_state, scaler=sstate, rng=rng,
+        data=it.state(), metrics=metrics, numerics=nstate)}
+    if args.preemptable:
+        mgr.install_preemption_handler(lambda: latest["state"])
+
+    with open(args.losses, "a") as f:
+        while done < args.steps:
+            x, y = next(it)
+            params, opt_state, sstate, nstate, metrics, rng, loss = (
+                train_step(params, opt_state, sstate, nstate, metrics,
+                           rng, x, y))
+            done += 1
+            f.write(f"S {done - 1} {float(loss).hex()}\n")
+            f.flush()
+            latest["state"] = capture(
+                done, params, opt_state, scaler=sstate, rng=rng,
+                data=it.state(), metrics=metrics, numerics=nstate)
+            mgr.maybe_save(latest["state"])
+            if args.die_at is not None and done == args.die_at:
+                os._exit(13)  # hard crash: no cleanup, threads killed
+            if mgr.preempted:
+                return 17
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+        f.write(f"F {int(metrics.total_steps)} "
+                f"{float(sstate.loss_scale)}\n")
+    mgr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    # exit without interpreter teardown: all results are already on
+    # disk (losses file flushed per line, manager barriered in close),
+    # and tensorstore/XLA background threads can abort ("terminate
+    # called without an active exception") during C++ static teardown
+    # under load — a post-work crash that would read as a test failure
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

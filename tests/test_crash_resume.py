@@ -1,0 +1,134 @@
+"""Crash/resume bit-exactness: the acceptance test for resumable state.
+
+A CPU training run (packed FusedAdam + masters, dynamic scaler, carried
+PRNG key, IndexedBatches, telemetry counters — see
+``tests/_resilience_train.py``) is hard-killed mid-run (``os._exit``, no
+cleanup: async checkpoint threads die mid-write) and resumed from the
+manager. The per-step loss records of crashed-prefix + resumed-suffix
+must be **byte-identical** to an uninterrupted run — covering packed
+optimizer state, scaler state, RNG stream and data-iterator position in
+one assertion. A second test delivers a real SIGTERM and proves the
+emergency-flush / resume path end to end.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+SCRIPT = str(Path(__file__).parent / "_resilience_train.py")
+
+
+def _run(*args, timeout=180):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(
+        [sys.executable, SCRIPT, *map(str, args)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _loss_lines(path):
+    """{step: full line} for the per-step records, plus the final
+    summary line (or None)."""
+    steps, final = {}, None
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line.startswith("S "):
+                steps[int(line.split()[1])] = line
+            elif line.startswith("F "):
+                final = line
+    return steps, final
+
+
+@pytest.mark.parametrize("die_at", [7])
+def test_crash_resume_loss_curve_bit_exact(tmp_path, die_at):
+    steps = 11
+    # 1) uninterrupted reference
+    base = _run("--steps", steps, "--root", tmp_path / "ref_ckpt",
+                "--losses", tmp_path / "ref.txt")
+    assert base.returncode == 0, base.stderr
+    ref, ref_final = _loss_lines(tmp_path / "ref.txt")
+    assert sorted(ref) == list(range(steps)) and ref_final
+
+    # 2) crashed run: hard os._exit after step die_at's loss record —
+    #    the async save in flight dies mid-write (tmp dir left behind)
+    crash = _run("--steps", steps, "--root", tmp_path / "ckpt",
+                 "--losses", tmp_path / "crash.txt", "--die-at", die_at)
+    assert crash.returncode == 13, crash.stderr
+    crashed, crashed_final = _loss_lines(tmp_path / "crash.txt")
+    assert crashed_final is None  # it really died mid-run
+    assert sorted(crashed) == list(range(die_at))
+
+    # 3) resume from the manager (automatic: resume_or_init)
+    resume = _run("--steps", steps, "--root", tmp_path / "ckpt",
+                  "--losses", tmp_path / "resume.txt")
+    assert resume.returncode == 0, resume.stderr
+    resumed, resumed_final = _loss_lines(tmp_path / "resume.txt")
+
+    # the resumed run restarted from a checkpointed step < die_at, not
+    # from scratch
+    first_resumed = min(resumed)
+    assert 0 < first_resumed < die_at
+    assert sorted(resumed) == list(range(first_resumed, steps))
+
+    # 4) BYTE-identical loss curve: replayed overlap AND new suffix both
+    #    match the uninterrupted run exactly (hex-formatted f32 losses)
+    for s in range(first_resumed, die_at):
+        assert resumed[s] == crashed[s], f"replay diverged at step {s}"
+    combined = {**crashed, **resumed}
+    assert combined == ref
+    # telemetry counters (total_steps) and scaler state continued too
+    assert resumed_final == ref_final
+
+
+def test_sigterm_preemption_flush_and_resume(tmp_path):
+    """A real SIGTERM mid-run flushes an emergency checkpoint; the next
+    invocation resumes from it and completes."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    losses = tmp_path / "pre.txt"
+    proc = subprocess.Popen(
+        [sys.executable, SCRIPT, "--steps", "100000",
+         "--root", str(tmp_path / "ckpt"), "--losses", str(losses),
+         "--preemptable", "--step-sleep", "0.05"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if losses.exists() and len(losses.read_text().splitlines()) >= 4:
+                break
+            if proc.poll() is not None:
+                pytest.fail(f"train process died early: "
+                            f"{proc.communicate()[1]}")
+            time.sleep(0.1)
+        else:
+            pytest.fail("train process produced no steps in time")
+        proc.send_signal(signal.SIGTERM)
+        _, err = proc.communicate(timeout=60)
+        assert proc.returncode == 17, err  # clean preempted exit
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # an emergency checkpoint exists at the preempted step
+    import json
+
+    root = tmp_path / "ckpt"
+    step_dirs = sorted(d for d in os.listdir(root)
+                       if d.startswith("step_") and ".tmp-" not in d)
+    assert step_dirs
+    with open(root / step_dirs[-1] / "meta.json") as f:
+        newest = json.load(f)
+    assert newest["emergency"] is True
+
+    # resume completes from there (a short remaining budget)
+    target = newest["step"] + 3
+    done = _run("--steps", target, "--root", root,
+                "--losses", tmp_path / "post.txt")
+    assert done.returncode == 0, done.stderr
+    resumed, final = _loss_lines(tmp_path / "post.txt")
+    assert min(resumed) == newest["step"]
+    assert sorted(resumed) == list(range(newest["step"], target))
+    assert final is not None

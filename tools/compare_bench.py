@@ -36,6 +36,7 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("fp8_gemm_vs_bf16", "fp8_e4m3_gemm_vs_bf16", True),
     ("fp8_model_tokens_per_sec", "gpt2_345m_fp8.tokens_per_sec", True),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
+    ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
 )
 
 # legs whose expected value is ~0, where a relative threshold would turn
@@ -43,6 +44,7 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
 # tolerance (same units as the metric) instead of a fraction of |base|
 ABS_TOLERANCE = {
     "telemetry_overhead_pct": 1.0,  # percentage points (the <=1% claim)
+    "resilience_overhead_pct": 1.0,  # ditto (docs/resilience.md)
 }
 
 

@@ -1,0 +1,239 @@
+"""Resilience chaos smoke — fast CI proof the fault tolerance works.
+
+Like ``tools/static_audit.py --self``, this self-hosts the subsystem on
+the repo's own machinery, small enough for the tier-1 CPU lane:
+
+- ``nan_rewind``       a tiny packed-FusedAdam train loop hits a chaos-
+                       poisoned data window (persistent NaN grads); the
+                       scaler's consecutive-skip counter crosses the
+                       budget, the numerics engine emits ONE edge-
+                       triggered ``scaler_stall``, the RewindController
+                       rewinds ONCE past the window, and training
+                       finishes finite.
+- ``failed_write``     a checkpoint commit fails mid-flight (chaos) and
+                       the newest COMMITTED checkpoint is corrupted
+                       post-hoc; restore falls back to the newest good
+                       step — atomicity + typed-corruption fallback.
+- ``watchdog``         a stalled wait trips the hang watchdog with an
+                       all-thread stack dump instead of hanging.
+
+Usage::
+
+    python tools/resilience_check.py --self           # table, exit 1 on fail
+    python tools/resilience_check.py --self --json
+    python tools/resilience_check.py --self --check nan_rewind
+
+Exit codes (CI contract, same as static_audit/health_report): 0 = all
+checks pass, 1 = a check failed, 2 = infra/usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+# script-mode invocation (`python tools/resilience_check.py ...`) puts
+# tools/ at sys.path[0]; the repo root must be importable for apex_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check_nan_rewind() -> dict:
+    """Persistent-NaN injection -> exactly one stall, one rewind, finite
+    training afterwards."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.resilience import (
+        ChaosMonkey, IndexedBatches, RewindController, capture,
+        poison_grads,
+    )
+    from apex_tpu.telemetry import MultiRecorder, RingBufferRecorder
+    from apex_tpu.telemetry import numerics as tnum
+
+    params = {"w": jnp.ones((16,), jnp.float32)}
+    opt = FusedAdam(lr=1e-2, packed=True, packed_interpret=True,
+                    packed_chunk_size=256)
+    sc = LossScaler("dynamic", init_scale=2.0 ** 4, hysteresis=1)
+    mon = tnum.NumericsMonitor(params, max_consecutive_skips=3)
+    rec = RingBufferRecorder()
+    ctl = RewindController(keep=2, skip_budget=3, recorder=rec,
+                           max_rewinds=2)
+    sink = MultiRecorder(rec, ctl)
+    chaos = ChaosMonkey().poison_batches(range(6, 10))
+    it = IndexedBatches(
+        lambda i: jnp.full((16,), 0.1 * ((i % 5) + 1), jnp.float32))
+
+    @jax.jit
+    def step(x, poison, params, opt_state, sstate, nstate):
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.sum(p["w"] * x))(params)
+        grads = poison_grads(grads, poison)
+        grads, sstate, nstate = sc.unscale(
+            sstate, grads, numerics=(mon, nstate))
+        params, opt_state = opt.step(
+            grads, opt_state, params, found_inf=sstate.found_inf)
+        sstate, nstate = sc.update_scale(sstate, numerics=nstate)
+        nstate = mon.drain(nstate, sink)
+        return loss, params, opt_state, sstate, nstate
+
+    opt_state, sstate, nstate = opt.init(params), sc.init_state(), mon.init()
+    losses, stepno, rewinds = [], 0, 0
+    while stepno < 18:
+        x = next(it)
+        poison = chaos.should_poison(it.position - 1)
+        loss, params, opt_state, sstate, nstate = step(
+            x, poison, params, opt_state, sstate, nstate)
+        losses.append(float(loss))
+        stepno += 1
+        st = capture(stepno, params, opt_state, scaler=sstate,
+                     numerics=nstate, data=it.state())
+        ctl.offer(st, consecutive_skips=sstate.consecutive_skips)
+        jax.effects_barrier()  # the stall event must land before poll
+        if ctl.rewind_pending:
+            restored = ctl.rewind(data_iter=it, skip_batches=4,
+                                  current_step=stepno)
+            params = jax.device_put(restored.params)
+            opt_state = jax.device_put(restored.opt_state)
+            sstate = jax.device_put(restored.scaler)
+            nstate = jax.device_put(restored.numerics)
+            stepno = int(restored.step)
+            rewinds += 1
+    jax.effects_barrier()
+    kinds = [r.get("kind") or r["event"] for r in rec.records]
+    tail_finite = bool(np.all(np.isfinite(losses[-4:])))
+    ok = (rewinds == 1 and kinds.count("scaler_stall") == 1
+          and kinds.count("rewind") == 1 and tail_finite)
+    return {"ok": ok, "rewinds": rewinds,
+            "scaler_stall_events": kinds.count("scaler_stall"),
+            "rewind_events": kinds.count("rewind"),
+            "tail_finite": tail_finite, "events": kinds}
+
+
+def check_failed_write() -> dict:
+    """A commit that dies mid-flight + post-hoc corruption of the newest
+    checkpoint: the previous good step stays loadable."""
+    import jax.numpy as jnp
+
+    from apex_tpu.resilience import (
+        ChaosError, ChaosMonkey, CheckpointManager, capture,
+        corrupt_checkpoint,
+    )
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    root = tempfile.mkdtemp(prefix="apex_tpu_resilience_check_")
+    try:
+        rec = RingBufferRecorder()
+        chaos = ChaosMonkey().fail_commit_at(6)
+        mgr = CheckpointManager(root, keep_n=3, sink=rec, chaos=chaos)
+        params = {"w": jnp.arange(8.0)}
+        template = capture(0, params, None)
+        for s in (2, 4):
+            mgr.save(capture(s, {"w": jnp.full((8,), float(s))}, None))
+        mgr.wait_until_finished()
+        # injected failure AFTER the tmp tree is written, BEFORE commit
+        mgr.save(capture(6, {"w": jnp.full((8,), 6.0)}, None))
+        failed_surfaced = False
+        try:
+            mgr.wait_until_finished()
+        except ChaosError:
+            failed_surfaced = True
+        after_fail = mgr.restore(template)
+        atomic_ok = (after_fail is not None and after_fail.step == 4
+                     and float(after_fail.params["w"][0]) == 4.0)
+        # post-hoc corruption of the newest committed step -> fallback
+        corrupt_checkpoint(os.path.join(root, "step_00000004"))
+        fell_back = mgr.restore(template)
+        fallback_ok = fell_back is not None and fell_back.step == 2
+        events = [r["event"] for r in rec.records]
+        ok = (failed_surfaced and atomic_ok and fallback_ok
+              and "checkpoint_failed" in events
+              and "checkpoint_fallback" in events)
+        return {"ok": ok, "failed_surfaced": failed_surfaced,
+                "atomic_ok": atomic_ok, "fallback_ok": fallback_ok,
+                "events": events}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def check_watchdog() -> dict:
+    """A stalled blocking point trips the watchdog with a stack dump
+    instead of hanging."""
+    import threading
+
+    from apex_tpu.resilience import HangError, HangWatchdog
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    rec = RingBufferRecorder()
+    with HangWatchdog(timeout_s=0.3, poll_s=0.02, sink=rec) as wd:
+        never = threading.Event()
+        tripped, has_stacks = False, False
+        try:
+            wd.wait(never, "stalled callback drain")
+        except HangError as e:
+            tripped = True
+            has_stacks = "MainThread" in e.stacks
+    hang_events = [r for r in rec.records if r["event"] == "hang"]
+    ok = tripped and has_stacks and len(hang_events) == 1
+    return {"ok": ok, "tripped": tripped, "has_stacks": has_stacks,
+            "hang_events": len(hang_events)}
+
+
+CHECKS = {
+    "nan_rewind": check_nan_rewind,
+    "failed_write": check_failed_write,
+    "watchdog": check_watchdog,
+}
+
+
+def run_checks(names=None) -> dict:
+    out = {"event": "resilience_check", "checks": {}}
+    ok = True
+    for name in (list(names) if names else sorted(CHECKS)):
+        res = CHECKS[name]()
+        out["checks"][name] = res
+        ok = ok and bool(res["ok"])
+    out["ok"] = ok
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Chaos smoke of apex_tpu.resilience on its own stack")
+    ap.add_argument("--self", action="store_true", dest="self_check",
+                    help="run the built-in chaos smokes (required mode)")
+    ap.add_argument("--check", action="append", choices=sorted(CHECKS),
+                    help="restrict to specific check(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full result as JSON")
+    args = ap.parse_args(argv)
+    if not args.self_check:
+        ap.error("nothing to do: pass --self (run the chaos smokes)")
+
+    try:
+        result = run_checks(args.check)
+    except Exception as e:  # infra failure must not read as "resilient"
+        print(f"resilience check failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        for name, res in result["checks"].items():
+            status = "PASS" if res["ok"] else "FAIL"
+            detail = {k: v for k, v in res.items()
+                      if k not in ("ok", "events")}
+            print(f"{status}  {name}  {detail}")
+        print("summary:", json.dumps({"ok": result["ok"]}))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
