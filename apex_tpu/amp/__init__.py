@@ -21,6 +21,7 @@ from .handle import (  # noqa: F401
 )
 from .frontend import (  # noqa: F401
     Properties,
+    cast_params_for_inference,
     initialize,
     opt_levels,
     state_dict,

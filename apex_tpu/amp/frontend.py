@@ -126,6 +126,24 @@ def cast_model(params: Pytree, dtype, keep_batchnorm_fp32: bool) -> Pytree:
     return jax.tree_util.tree_map_with_path(leaf, params)
 
 
+def cast_params_for_inference(params: Pytree, dtype,
+                              keep_batchnorm_fp32: bool = False) -> Pytree:
+    """One-shot inference cast: float leaves to ``dtype``, no master
+    copies, no scaler.
+
+    The serving-side entry into the O2 cast machinery: the SAME walk as
+    :func:`cast_model` (float-leaf detection, the batchnorm key-path
+    heuristic — one copy of the tables to keep in sync), named as what
+    it is: a *deployment* cast with no optimizer to hold fp32 masters,
+    so the cast params ARE the weights. Leaves already in the target
+    dtype come back **unchanged** (``astype`` to the same dtype is the
+    identity — no device copy, pinned by test), so re-casting an
+    already-cast tree — an engine restart, a second engine over the
+    same weights — costs nothing.
+    """
+    return cast_model(params, jnp.dtype(dtype), keep_batchnorm_fp32)
+
+
 class AmpState:
     """Explicit replacement for the reference's ``_amp_state`` global."""
 

@@ -34,3 +34,8 @@ from .flash_attention import (  # noqa: F401
     flash_attention_sbhd,
     flash_attention_available,
 )
+from .flash_decode import (  # noqa: F401
+    flash_decode,
+    flash_decode_available,
+    paged_decode_reference,
+)

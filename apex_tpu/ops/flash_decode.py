@@ -1,0 +1,278 @@
+"""Flash *decode*: single-query attention over a paged KV cache.
+
+The serving-side sibling of ``ops/flash_attention.py``. Training
+attention streams ``[block_q, block_k]`` score tiles of one contiguous
+sequence; decode attention has exactly ONE query row per request (the
+token being generated) and its keys/values live in fixed-size *pages*
+scattered through a shared pool (``apex_tpu.serving.kv_cache``) — the
+PagedAttention/vLLM layout. The kernel therefore grids over
+``(slot, page)`` and runs the online-softmax recurrence *across page
+blocks*: per slot a running row-max ``m``, normalizer ``l`` and value
+accumulator are carried in VMEM scratch while each grid step loads one
+page of K/V.
+
+The page indirection uses Pallas **scalar prefetch**
+(``pltpu.PrefetchScalarGridSpec``): the per-slot page table and kv
+lengths are SMEM-prefetched so each grid step's BlockSpec index map can
+point the K/V DMA at ``page_table[slot, i]`` — the pool page is fetched
+directly, never gathered into a contiguous copy. Page-table entries past
+a request's length MUST still be valid pool indices (the serving layer
+points them at the reserved garbage page 0): the block is DMA'd either
+way, and the compute is ``pl.when``-gated off for fully-invalid pages,
+with in-page masking (``pos < kv_len``) for the ragged tail page.
+
+Layouts (head-major pages — keeps the in-kernel dots transpose-free):
+
+- ``q``        ``[n_slots, n_heads, head_dim]``
+- ``k_pages``  ``[n_pages, n_heads, page_size, head_dim]``
+- ``v_pages``  ``[n_pages, n_heads, page_size, head_dim]``
+- ``page_table`` ``[n_slots, pages_per_seq]`` int32
+- ``kv_lens``  ``[n_slots]`` int32 (valid tokens; 0 = inactive slot)
+
+Rows with ``kv_lens == 0`` output zeros (the training kernels'
+fully-masked-row convention, ``flash_attention.py``).
+
+Like ``packed_optimizer.py``, every entry point has an XLA fallback
+(``use_kernel=False``, auto-selected off-TPU) computing identical fp32
+math via a gather, and the kernel body runs under the Pallas interpreter
+(``interpret=True``) so CPU tests exercise the real kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is importable on CPU-only hosts too; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _kernel_ok(use_kernel: Optional[bool], interpret: bool) -> bool:
+    """Kernel path on TPU or when explicitly interpreted; XLA fallback
+    elsewhere (the ``packed_optimizer.py`` selection contract)."""
+    if pltpu is None:
+        return False
+    if use_kernel is not None:
+        return bool(use_kernel)
+    return bool(interpret) or jax.default_backend() == "tpu"
+
+
+def flash_decode_available(page_size: int, head_dim: int) -> bool:
+    """Kernel tileability: the page is the sublane dim of the K/V blocks
+    (Mosaic wants multiples of 8) and head_dim <= 256 keeps the MXU
+    happy (same rule as ``flash_attention_available``)."""
+    return page_size % 8 == 0 and head_dim <= 256
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    pt_ref, len_ref,  # scalar-prefetch: [b, mp] page table, [b] kv lens
+    q_ref,            # [1, n, d] this slot's query
+    k_ref, v_ref,     # [1, n, ps, d] the page pt_ref[b, i]
+    o_ref,            # [1, n, d]
+    m_scr, l_scr, acc_scr,
+    *, scale, page_size, n_pages_per_seq,
+):
+    b, i = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kv_len = len_ref[b]
+
+    # pages wholly past the sequence are skipped (their DMA still ran —
+    # the table points them at the garbage page — but no flops/scratch)
+    @pl.when(i * page_size < kv_len)
+    def _compute():
+        # fp32 q, scale folded in (one row per head — negligible work)
+        q = q_ref[0].astype(jnp.float32) * scale          # [n, d]
+        k = k_ref[0]                                      # [n, ps, d]
+        # s[n, ps] = per-head q . k — head-major pages make this a
+        # batched dot with NO transpose
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [n, ps]
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < kv_len, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                             # [n, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)          # ragged tail
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev <= _NEG_INF / 2, 0.0, alpha)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [n, d]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(i == n_pages_per_seq - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        # kv_len == 0 slots never ran _compute: acc/l are zero -> zeros out
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k_pages, v_pages, page_table, kv_lens, scale,
+                   interpret):
+    b, n, d = q.shape
+    ps = k_pages.shape[2]
+    mp = page_table.shape[1]
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, page_size=ps, n_pages_per_seq=mp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mp),
+        in_specs=[
+            pl.BlockSpec((1, n, d), lambda b, i, pt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, n, ps, d),
+                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, n, ps, d),
+                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n, d), lambda b, i, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n, 128), jnp.float32),
+            pltpu.VMEM((n, 128), jnp.float32),
+            pltpu.VMEM((n, d), jnp.float32),
+        ],
+    )
+    # jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept both
+    cp_cls = getattr(pltpu, "CompilerParams",
+                     getattr(pltpu, "TPUCompilerParams", None))
+    compiler_params = None
+    if cp_cls is not None:
+        compiler_params = cp_cls(
+            dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        name="apex_tpu_flash_decode",
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, d), q.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback / reference
+# ---------------------------------------------------------------------------
+
+
+def _decode_xla(q, k_pages, v_pages, page_table, kv_lens, scale):
+    """Gather-based paged decode attention: identical math, O(b * mp * ps)
+    gathered K/V copies (the fallback honesty note: the kernel exists to
+    avoid exactly this materialisation)."""
+    b, n, d = q.shape
+    ps = k_pages.shape[2]
+    mp = page_table.shape[1]
+    k = k_pages[page_table]  # [b, mp, n, ps, d]
+    v = v_pages[page_table]
+    s = jnp.einsum(
+        "bnd,bmnpd->bnmp", q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32), preferred_element_type=jnp.float32,
+    ).reshape(b, n, mp * ps)
+    pos = jnp.arange(mp * ps, dtype=jnp.int32)
+    s = jnp.where(pos[None, None, :] < kv_lens[:, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # fully-masked rows (kv_len == 0): zeros out, matching the kernel
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    ctx = jnp.einsum(
+        "bnk,bnkd->bnd", p.astype(jnp.float32),
+        v.astype(jnp.float32).transpose(0, 2, 1, 3, 4).reshape(
+            b, n, mp * ps, d),
+        preferred_element_type=jnp.float32,
+    )
+    return (ctx / jnp.maximum(l, 1.0e-37)).astype(q.dtype) * (
+        l > 0.0).astype(q.dtype)
+
+
+def paged_decode_reference(q, k_pages, v_pages, page_table, kv_lens,
+                           scale=None):
+    """Materialised reference (tests): dense softmax over the gathered
+    pages with the zeros-for-empty-slots convention."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _decode_xla(q, k_pages, v_pages, page_table, kv_lens,
+                       float(scale))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@jax.named_scope("apex_tpu.flash_decode")
+def flash_decode(
+    q: jax.Array,            # [n_slots, n_heads, head_dim]
+    k_pages: jax.Array,      # [n_pages, n_heads, page_size, head_dim]
+    v_pages: jax.Array,      # [n_pages, n_heads, page_size, head_dim]
+    page_table: jax.Array,   # [n_slots, pages_per_seq] int32
+    kv_lens: jax.Array,      # [n_slots] int32
+    *,
+    scale: Optional[float] = None,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single-query paged attention: ``softmax(q @ K_pages^T * scale) @
+    V_pages`` per slot, online-softmax across page blocks. Returns
+    ``[n_slots, n_heads, head_dim]`` in ``q.dtype``.
+
+    ``page_table[slot, i]`` is the pool index of the slot's i-th page;
+    entries past ``ceil(kv_len / page_size)`` must still be valid pool
+    indices (point them at the reserved garbage page — they are loaded
+    but never read). Slots with ``kv_lens == 0`` return zeros.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"k_pages {k_pages.shape} and v_pages {v_pages.shape} differ")
+    if k_pages.shape[1] != q.shape[1] or k_pages.shape[3] != q.shape[2]:
+        raise ValueError(
+            f"pages [P, n, ps, d] = {k_pages.shape} do not match q "
+            f"[b, n, d] = {q.shape}")
+    # NO pool-level dtype cast: materializing a q.dtype copy of the
+    # whole [P, n, ps, d] pool per call is exactly the O(pool) work the
+    # paged design avoids. Both paths handle mixed dtypes themselves —
+    # the kernel upcasts q/scores to fp32 in VMEM and dots bf16 K/V
+    # blocks directly; the XLA fallback casts AFTER the gather.
+    if not _kernel_ok(use_kernel, interpret):
+        return _decode_xla(q, k_pages, v_pages, page_table,
+                           kv_lens.astype(jnp.int32), float(scale))
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True
+    if not flash_decode_available(k_pages.shape[2], q.shape[2]):
+        raise ValueError(
+            f"flash_decode kernel needs page_size {k_pages.shape[2]} % 8 "
+            f"== 0 and head_dim {q.shape[2]} <= 256 "
+            "(use_kernel=False for the XLA fallback)")
+    return _decode_pallas(q, k_pages, v_pages, page_table,
+                          kv_lens.astype(jnp.int32), float(scale),
+                          interpret)

@@ -1,0 +1,63 @@
+"""apex_tpu.serving: single-chip paged-KV inference with continuous
+batching.
+
+The "millions of users" half of the north star, assembled from the
+training stack's own machinery:
+
+- :mod:`~apex_tpu.serving.kv_cache` — the paged KV cache:
+  :class:`PagedKVSpec` lays the page pools out as chunk-aligned packed
+  buffers on ``multi_tensor_apply.packing.PackSpec`` (one page = one
+  chunk; ``analysis.check_pack_spec`` verifies it), plus the host-side
+  :class:`PageAllocator` free list;
+- :mod:`~apex_tpu.serving.decode_model` — token-at-a-time GPT forward
+  against the cache, attention by ``ops.flash_decode`` (online-softmax
+  across pages, Pallas scalar-prefetch kernel with XLA fallback);
+- :mod:`~apex_tpu.serving.scheduler` — Orca-style iteration-level
+  continuous batching: admit/evict between steps, lazy page allocation,
+  recompute-mode preemption when the pool runs dry;
+- :mod:`~apex_tpu.serving.engine` — :class:`ServingEngine`: ONE jitted
+  fixed-shape step interleaving prefill and decode (each slot consumes
+  one token per step), KV/slot/metrics state donated, sampled tokens
+  fed back on device, telemetry through the PR-2 cond-gated drain, and
+  the PR-4 auditor as the invariant gate (``engine.audit()``).
+
+``tools/serving_check.py --self`` is the CI smoke; ``docs/serving.md``
+the design document; ``bench.py``'s ``serving_throughput`` /
+``prefill_decode_split`` legs the measurements.
+"""
+from .engine import (  # noqa: F401
+    ServingEngine,
+    SlotState,
+    default_page_size,
+)
+from .decode_model import decode_tokens, reference_decode  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    KVCacheState,
+    PageAllocator,
+    PagedKVSpec,
+    page_table_row,
+    write_token_kv,
+)
+from .scheduler import (  # noqa: F401
+    Request,
+    RunningSlot,
+    Scheduler,
+    SchedulerError,
+)
+
+__all__ = [
+    "KVCacheState",
+    "PageAllocator",
+    "PagedKVSpec",
+    "Request",
+    "RunningSlot",
+    "Scheduler",
+    "SchedulerError",
+    "ServingEngine",
+    "SlotState",
+    "decode_tokens",
+    "default_page_size",
+    "page_table_row",
+    "reference_decode",
+    "write_token_kv",
+]

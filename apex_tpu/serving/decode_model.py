@@ -1,0 +1,156 @@
+"""Token-at-a-time GPT forward over the paged KV cache.
+
+The inference twin of
+``transformer.testing.standalone_transformer_lm``: same parameter
+pytree (``init_gpt_params``), same per-layer math (pre-LN, fused-QKV
+attention, GeLU MLP, tied-embedding head), but evaluated for ONE token
+per slot against K/V read from — and appended to — the paged pool
+(``serving.kv_cache``), with attention by ``ops.flash_decode``.
+
+Everything is fixed-shape over the ``[n_slots]`` slot batch; per-slot
+variation (prefill vs decode, active vs idle) is select-gated so the one
+compiled program serves any mix — the Orca-style single-program
+iteration the scheduler batches into. Inactive slots index the reserved
+garbage page and contribute zero attention (``kv_lens == 0``), so no
+host branching ever reshapes the program.
+
+Dtype discipline mirrors training: LayerNorm in fp32, GEMMs in
+``cfg.compute_dtype``, logits fp32 (``_lm_head`` parity) — so a bf16
+engine serves the same numerics the bf16 training forward produced.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_decode import flash_decode
+from ..ops.layer_norm import layer_norm as fused_layer_norm
+from .kv_cache import KVCacheState, PagedKVSpec, write_token_kv
+
+Pytree = Any
+
+
+def _ln(x, w, b, eps):
+    """fp32 LayerNorm over the trailing dim (training-path parity:
+    ``transformer_layer`` normalizes in fp32 and casts back)."""
+    return fused_layer_norm(
+        x.astype(jnp.float32), w.astype(jnp.float32),
+        b.astype(jnp.float32), eps=eps)
+
+
+def decode_tokens(
+    cfg,
+    params: Pytree,
+    spec: PagedKVSpec,
+    kv: KVCacheState,
+    tokens: jax.Array,       # [B] int32 — the token each slot consumes
+    positions: jax.Array,    # [B] int32 — its position (= tokens cached)
+    active: jax.Array,       # [B] bool
+    page_tables: jax.Array,  # [B, pages_per_seq] int32
+    *,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, KVCacheState]:
+    """One decode step: embed, run every layer against the paged cache
+    (appending this token's K/V in place), return fp32 logits
+    ``[B, vocab]`` and the updated cache.
+
+    Inactive slots are fully select-gated: token/position 0, writes to
+    the garbage page, zero attention — their logits are garbage and the
+    caller masks them.
+    """
+    B = tokens.shape[0]
+    n, d, ps = spec.num_heads, spec.head_dim, spec.page_size
+    compute = cfg.compute_dtype
+    eps = cfg.layernorm_epsilon
+
+    tok = jnp.where(active, tokens, 0).astype(jnp.int32)
+    pos = jnp.where(active, positions, 0).astype(jnp.int32)
+
+    word = jnp.take(params["embedding"]["word"], tok, axis=0)
+    posemb = jnp.take(params["embedding"]["position"], pos, axis=0)
+    h = (word + posemb).astype(compute)  # [B, h]
+
+    # this token's write destination; inactive slots land on the garbage
+    # page (their page-table row is all GARBAGE_PAGE)
+    page_idx = jnp.take_along_axis(
+        page_tables.astype(jnp.int32), (pos // ps)[:, None], axis=1)[:, 0]
+    offsets = pos % ps
+    kv_lens = jnp.where(active, pos + 1, 0).astype(jnp.int32)
+
+    layers = params["layers"]
+    L = cfg.num_layers
+    scale = 1.0 / (d ** 0.5)
+
+    def layer_body(l, carry):
+        h, pages = carry
+        lp = jax.tree_util.tree_map(lambda a: a[l], layers)
+        dt = h.dtype
+
+        ln1 = _ln(h, lp["input_ln_w"], lp["input_ln_b"], eps).astype(dt)
+        qkv = (jnp.einsum("bh,oh->bo", ln1, lp["qkv_w"].astype(dt))
+               + lp["qkv_b"].astype(dt))                    # [B, 3h]
+        # the training layout: [.., n, 3*d] split into thirds
+        qkv = qkv.reshape(B, n, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)                # [B, n, d]
+
+        pages = write_token_kv(pages, l, k, v, page_idx, offsets)
+        k_pages = pages[l, 0]
+        v_pages = pages[l, 1]
+        ctx = flash_decode(
+            q, k_pages, v_pages, page_tables, kv_lens, scale=scale,
+            use_kernel=use_kernel, interpret=interpret,
+        ).astype(dt)
+
+        attn = (jnp.einsum("bo,ho->bh", ctx.reshape(B, n * d),
+                           lp["proj_w"].astype(dt))
+                + lp["proj_b"].astype(dt))
+        h = (h + attn).astype(dt)
+
+        ln2 = _ln(h, lp["post_ln_w"], lp["post_ln_b"], eps).astype(dt)
+        inter = (jnp.einsum("bh,oh->bo", ln2, lp["fc1_w"].astype(dt))
+                 + lp["fc1_b"].astype(dt))
+        inter = jax.nn.gelu(inter, approximate=True)
+        mlp = (jnp.einsum("bo,ho->bh", inter, lp["fc2_w"].astype(dt))
+               + lp["fc2_b"].astype(dt))
+        h = (h + mlp).astype(dt)
+        return (h, pages)
+
+    h, pages = jax.lax.fori_loop(0, L, layer_body, (h, kv.pages))
+
+    h = _ln(h, params["final_ln_w"], params["final_ln_b"],
+            eps).astype(compute)
+    # tied-embedding head, fp32 logits (training `_lm_head` parity)
+    logits = jnp.einsum(
+        "bh,vh->bv", h, params["embedding"]["word"].astype(compute),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, KVCacheState(pages=pages)
+
+
+def reference_decode(cfg, params, prompt, max_new_tokens: int,
+                     eos_id: Optional[int] = None):
+    """Per-request dense-attention greedy decode — the oracle.
+
+    Recomputes the FULL training forward (``gpt_forward``: dense/flash
+    attention over the whole prefix, no KV cache) for every emitted
+    token and takes the argmax. O(len^2) per token; tests and
+    ``tools/serving_check.py`` hold ``ServingEngine.generate`` to
+    token-identity against this loop.
+    """
+    from ..transformer.testing.standalone_transformer_lm import gpt_forward
+
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(int(max_new_tokens)):
+        logits = gpt_forward(
+            cfg, params, jnp.asarray([toks], jnp.int32),
+            deterministic=True)
+        nxt = int(jnp.argmax(logits[0, -1].astype(jnp.float32)))
+        out.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+        toks.append(nxt)
+    return out

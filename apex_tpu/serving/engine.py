@@ -1,0 +1,416 @@
+"""ServingEngine: the continuous-batching decode loop.
+
+One jitted, fixed-shape **unified step** serves every phase: each active
+slot consumes exactly one token per step — a prompt token while
+prefilling, its own last sampled token while decoding — so prefill and
+decode interleave freely inside one program (Orca-style iteration-level
+batching) and a long prompt never stalls other requests' token cadence.
+
+Sync discipline (the serving analogue of the training-step rules the
+PR-4 auditor enforces):
+
+- the KV cache, the per-slot device state and the telemetry
+  ``MetricsState`` are **donated** into the step — page writes and slot
+  updates are in place;
+- the sampled token feeds back to the next step **on device** (the
+  ``SlotState`` carry), so the host never round-trips a token to keep a
+  slot running;
+- in-jit telemetry drains through the PR-2 cond-gated async callback —
+  there is no other callback in the program. ``audit()`` /
+  ``analysis.assert_step_clean`` verify all of this on the traced step;
+- the single host read per step is the fetch of that step's emitted
+  tokens, which the scheduler needs for EOS/finish decisions (and the
+  caller needs anyway — it IS the output).
+
+Scheduling (admission, lazy page allocation, preemption, eviction) runs
+on the host between steps (``serving.scheduler``); its decisions reach
+the device as one masked slot-state update plus the small per-step
+page-table upload.
+
+Weights are cast ONCE at engine construction through the amp cast
+tables (``amp.cast_params_for_inference``) — bf16 serving reuses the
+training stack's mixed-precision discipline with no master copies.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..amp import cast_params_for_inference
+from ..ops.flash_decode import _kernel_ok, flash_decode_available
+from .decode_model import decode_tokens, reference_decode  # noqa: F401
+from .kv_cache import KVCacheState, PagedKVSpec
+from .scheduler import Request, Scheduler, SchedulerError
+
+Pytree = Any
+
+
+class SlotState(NamedTuple):
+    """Per-slot device state carried (donated) step to step."""
+
+    tokens: jax.Array       # [B] i32 — token each slot consumes next
+    positions: jax.Array    # [B] i32 — its position
+    active: jax.Array       # [B] bool
+    prompt_buf: jax.Array   # [B, max_seq_len] i32 — prompt (replay) text
+    prompt_lens: jax.Array  # [B] i32
+
+
+def default_page_size(num_heads: int, head_dim: int) -> int:
+    """Smallest power-of-two page (>= 8 tokens) whose K/V page is
+    ROW-aligned (``kv_cache.PagedKVSpec`` requirement)."""
+    from ..multi_tensor_apply.packing import ROW
+
+    for ps in (8, 16, 32, 64, 128, 256):
+        if (num_heads * ps * head_dim) % ROW == 0:
+            return ps
+    raise ValueError(
+        f"no power-of-two page size <= 256 aligns {num_heads} heads x "
+        f"{head_dim} dim pages to {ROW} elements")
+
+
+class ServingEngine:
+    """Single-chip paged-KV decode engine over a
+    ``standalone_transformer_lm`` GPT parameter pytree.
+
+    ``generate(requests)`` drives submitted :class:`~.scheduler.Request`
+    objects to completion under continuous batching and returns
+    ``{rid: [token, ...]}``; greedy (argmax) sampling — the decoding
+    mode the token-identity acceptance is defined over.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params: Pytree,
+        *,
+        n_slots: int = 4,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        pages_per_seq: Optional[int] = None,
+        max_prompt_len: Optional[int] = None,
+        kv_dtype: Any = None,
+        telemetry_every: int = 0,
+        record_every: int = 16,
+        sink=None,
+        use_kernel: Optional[bool] = None,
+        interpret: bool = False,
+    ):
+        self.cfg = cfg
+        n, d = cfg.num_attention_heads, cfg.kv_channels
+        ps = page_size or default_page_size(n, d)
+        max_seq = cfg.max_position_embeddings
+        # mp*ps may overshoot max_seq (pages quantize); submit() holds
+        # requests to max_position_embeddings either way
+        mp = pages_per_seq or -(-max_seq // ps)
+        num_pages = num_pages or (n_slots * mp + 1)
+        self.spec = PagedKVSpec(
+            cfg.num_layers, n, d, page_size=ps, num_pages=num_pages,
+            pages_per_seq=mp, dtype=kv_dtype or cfg.compute_dtype)
+        self.n_slots = int(n_slots)
+        self.max_prompt_len = int(max_prompt_len or max_seq)
+        # the on-device prompt buffer must hold preemption-replay
+        # prompts (original prompt + generated so far): cap = max seq
+        self._buf_len = min(self.spec.max_seq_len, max_seq)
+        # one-shot inference cast through the amp tables: bf16/fp16
+        # weights for a low-precision compute dtype, no master copies
+        self.params = cast_params_for_inference(params, cfg.compute_dtype)
+        self.sink = sink if sink is not None else telemetry.NullRecorder()
+        self.telemetry_every = int(telemetry_every)
+        self.record_every = int(record_every)
+        self._use_kernel = use_kernel
+        self._interpret = bool(interpret)
+        # fail at construction, not at the first traced step: if the
+        # kernel path would be selected, its tileability contract must
+        # hold for this (page_size, head_dim)
+        if (_kernel_ok(use_kernel, self._interpret)
+                and not flash_decode_available(ps, d)):
+            raise ValueError(
+                f"flash_decode kernel cannot tile page_size={ps}, "
+                f"head_dim={d} (needs page_size % 8 == 0 and head_dim "
+                "<= 256); pass use_kernel=False for the XLA fallback "
+                "or pick a compatible page_size")
+        self.scheduler = Scheduler(self.spec, self.n_slots,
+                                   max_prompt_len=self._buf_len)
+        self.kv = self.spec.init_cache()
+        self.slots = self._init_slots()
+        self.metrics = telemetry.init_metrics()
+        self._step = self._build_step()
+        self._mutate = jax.jit(_mutate_slots, donate_argnums=(0,))
+        self._occupants: List[Optional[int]] = [None] * self.n_slots
+        self.steps_run = 0
+        self.last_stats: Dict[str, Any] = {}
+        self._accum = self._fresh_accum()
+
+    @staticmethod
+    def _fresh_accum() -> Dict[str, Any]:
+        return {
+            "steps": 0, "active_slot_steps": 0, "prefill_slot_steps": 0,
+            "decode_slot_steps": 0, "step_time_s": 0.0,
+            "prefill_step_time_s": 0.0, "decode_step_time_s": 0.0,
+            "step_times_ms": [],
+        }
+
+    # -- construction ------------------------------------------------------
+    def _init_slots(self) -> SlotState:
+        B, W = self.n_slots, self._buf_len
+        return SlotState(
+            tokens=jnp.zeros((B,), jnp.int32),
+            positions=jnp.zeros((B,), jnp.int32),
+            active=jnp.zeros((B,), bool),
+            prompt_buf=jnp.zeros((B, W), jnp.int32),
+            prompt_lens=jnp.zeros((B,), jnp.int32),
+        )
+
+    def _build_step(self):
+        cfg, spec = self.cfg, self.spec
+        buf_len = self._buf_len
+        use_kernel, interpret = self._use_kernel, self._interpret
+        tel_every, sink = self.telemetry_every, self.sink
+
+        def step(params, kv, slots, page_tables, metrics):
+            logits, kv = decode_tokens(
+                cfg, params, spec, kv, slots.tokens, slots.positions,
+                slots.active, page_tables,
+                use_kernel=use_kernel, interpret=interpret)
+            sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            next_pos = slots.positions + 1
+            still_prefill = next_pos < slots.prompt_lens
+            prompt_next = jnp.take_along_axis(
+                slots.prompt_buf,
+                jnp.minimum(next_pos, buf_len - 1)[:, None], axis=1)[:, 0]
+            # a slot that just consumed its LAST prompt token emits its
+            # first generated token; decode slots emit every step
+            emitted = jnp.where(slots.active & ~still_prefill,
+                                sampled, jnp.int32(-1))
+            next_tok = jnp.where(still_prefill, prompt_next, sampled)
+            slots = SlotState(
+                tokens=jnp.where(slots.active, next_tok, slots.tokens),
+                positions=jnp.where(slots.active, next_pos,
+                                    slots.positions),
+                active=slots.active,
+                prompt_buf=slots.prompt_buf,
+                prompt_lens=slots.prompt_lens,
+            )
+            if tel_every > 0:
+                metrics = telemetry.accumulate(
+                    metrics,
+                    tokens=jnp.sum((emitted >= 0).astype(jnp.float32)))
+                metrics = telemetry.drain(
+                    metrics, sink, every_n=tel_every, tag="serving")
+            return kv, slots, emitted, metrics
+
+        return jax.jit(step, donate_argnums=(1, 2, 4))
+
+    # -- audit surface -----------------------------------------------------
+    def step_program(self):
+        """(jitted step, example args): the surface
+        ``analysis.assert_step_clean`` audits — donated KV/slot/metrics
+        state, cond-gated callbacks only."""
+        B, mp = self.n_slots, self.spec.pages_per_seq
+        args = (self.params, self.spec.init_cache(), self._init_slots(),
+                jnp.zeros((B, mp), jnp.int32), telemetry.init_metrics())
+        return self._step, args
+
+    def audit(self, **kw):
+        """Static audit of the decode step (PR-4 auditor); raises on
+        error-severity findings, returns the report."""
+        from ..analysis import assert_step_clean
+
+        fn, args = self.step_program()
+        kw.setdefault("name", "serving_decode_step")
+        kw.setdefault("pack_specs", [self.spec.pack_spec])
+        return assert_step_clean(fn, *args, **kw)
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, req: Request) -> None:
+        if len(req.prompt) > self.max_prompt_len:
+            raise SchedulerError(
+                f"request {req.rid}: prompt {len(req.prompt)} exceeds "
+                f"max_prompt_len {self.max_prompt_len}")
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.cfg.max_position_embeddings:
+            raise SchedulerError(
+                f"request {req.rid}: prompt+max_new = {total} exceeds "
+                f"max_position_embeddings "
+                f"{self.cfg.max_position_embeddings}")
+        if req.max_new_tokens < 1:
+            raise SchedulerError(f"request {req.rid}: max_new_tokens < 1")
+        req.t_arrival = time.perf_counter()
+        self.scheduler.submit(req)
+
+    # -- the loop ----------------------------------------------------------
+    def _sync_device_slots(self) -> None:
+        """Push occupancy changes (admissions, evictions, preemptions)
+        to the device slot state as ONE masked update."""
+        sched = self.scheduler
+        B, W = self.n_slots, self._buf_len
+        mask = np.zeros((B,), bool)
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        prompt_buf = np.zeros((B, W), np.int32)
+        prompt_lens = np.zeros((B,), np.int32)
+        for i in range(B):
+            run = sched.slots[i]
+            rid = None if run is None else run.req.rid
+            if rid == self._occupants[i]:
+                continue  # unchanged occupancy: device carry is current
+            mask[i] = True
+            self._occupants[i] = rid
+            if run is None:
+                continue  # deactivate row (zeros, active=False)
+            plen = len(run.prompt)
+            assert run.pos == 0, "admission must start at position 0"
+            tokens[i] = run.prompt[0]
+            active[i] = True
+            prompt_buf[i, :plen] = np.asarray(run.prompt, np.int32)
+            prompt_lens[i] = plen
+        if not mask.any():
+            return
+        new = SlotState(
+            tokens=jnp.asarray(tokens), positions=jnp.asarray(positions),
+            active=jnp.asarray(active),
+            prompt_buf=jnp.asarray(prompt_buf),
+            prompt_lens=jnp.asarray(prompt_lens))
+        self.slots = self._mutate(self.slots, jnp.asarray(mask), new)
+
+    def run_step(self) -> np.ndarray:
+        """One scheduling boundary + one device step; returns the
+        emitted-token vector ([B], -1 = no token)."""
+        sched = self.scheduler
+        sched.admit()
+        sched.ensure_capacity()
+        self._sync_device_slots()
+        page_tables = jnp.asarray(sched.page_table_array())
+        # host classification BEFORE the step (deterministic mirrors):
+        # which slots consume prompt vs generated tokens this step
+        served = sched.running()
+        prefill_slots = [i for i, r in served if r.prefilling]
+        decode_slots = [i for i, r in served if not r.prefilling]
+        t0 = time.perf_counter()
+        self.kv, self.slots, emitted, self.metrics = self._step(
+            self.params, self.kv, self.slots, page_tables, self.metrics)
+        em = np.asarray(emitted)  # the one host sync per step
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        sched.advance([i for i, _ in served])
+        for i, run in served:
+            tok = int(em[i])
+            if tok < 0:
+                continue
+            req = run.req
+            if req.t_first_token is None:
+                req.t_first_token = now
+            req.out_tokens.append(tok)
+            if req.done:
+                req.t_done = now
+                sched.evict(i)
+        self.steps_run += 1
+        self._acct(len(served), len(prefill_slots), len(decode_slots), dt)
+        return em
+
+    def _acct(self, n_active, n_prefill, n_decode, dt):
+        a = self._accum
+        a["steps"] += 1
+        a["active_slot_steps"] += n_active
+        a["prefill_slot_steps"] += n_prefill
+        a["decode_slot_steps"] += n_decode
+        a["step_time_s"] += dt
+        # mixed steps pro-rate wall time by slot counts (matching the
+        # slot-step accounting above) — under continuous batching most
+        # steps serve both phases at once
+        if n_prefill or n_decode:
+            frac = n_prefill / (n_prefill + n_decode)
+            a["prefill_step_time_s"] += dt * frac
+            a["decode_step_time_s"] += dt * (1.0 - frac)
+        a["step_times_ms"].append(dt * 1e3)
+        if self.record_every and a["steps"] % self.record_every == 0:
+            self.sink.record({
+                "event": "serving_step", "step": self.steps_run,
+                "active": n_active,
+                "occupancy": n_active / self.n_slots,
+                "free_pages": self.scheduler.allocator.free_count,
+            })
+
+    def generate(self, requests: Sequence[Request],
+                 max_steps: Optional[int] = None) -> Dict[int, List[int]]:
+        """Run a request trace to completion under continuous batching.
+
+        Requests with ``arrival_step > 0`` are held back and submitted
+        at that step boundary — the staggered-admission traces the
+        token-identity acceptance runs. Returns ``{rid: tokens}`` and
+        fills :attr:`last_stats` (latency percentiles via
+        ``telemetry.percentiles``, throughput, occupancy, the
+        prefill/decode split).
+        """
+        self._accum = self._fresh_accum()
+        pending = sorted(requests, key=lambda r: (r.arrival_step, r.rid))
+        all_reqs = list(pending)
+        t_start = time.perf_counter()
+        step_i = 0
+        while True:
+            while pending and pending[0].arrival_step <= step_i:
+                self.submit(pending.pop(0))
+            if not pending and self.scheduler.idle:
+                break
+            if max_steps is not None and step_i >= max_steps:
+                raise SchedulerError(
+                    f"generate exceeded max_steps={max_steps} with "
+                    f"{len(pending)} pending and "
+                    f"{self.scheduler.n_active} active")
+            if self.scheduler.idle:
+                step_i += 1  # gap before the next arrival
+                continue
+            self.run_step()
+            step_i += 1
+        wall = time.perf_counter() - t_start
+        self.last_stats = self._summarize(all_reqs, wall)
+        self.sink.record({"event": "serving_summary", **self.last_stats})
+        return {r.rid: list(r.out_tokens) for r in all_reqs}
+
+    def _summarize(self, reqs, wall_s) -> Dict[str, Any]:
+        a = self._accum
+        total_tokens = sum(len(r.out_tokens) for r in reqs)
+        lat_ms = [(r.t_done - r.t_arrival) * 1e3 for r in reqs
+                  if r.t_done is not None and r.t_arrival is not None]
+        ttft_ms = [(r.t_first_token - r.t_arrival) * 1e3 for r in reqs
+                   if r.t_first_token is not None
+                   and r.t_arrival is not None]
+        slot_steps = a["active_slot_steps"]
+        return {
+            "n_requests": len(reqs),
+            "completed": sum(r.done for r in reqs),
+            "preemptions": sum(r.preemptions for r in reqs),
+            "steps": a["steps"],
+            "wall_s": round(wall_s, 4),
+            "generated_tokens": total_tokens,
+            "tokens_per_sec": round(total_tokens / wall_s, 2)
+            if wall_s > 0 else None,
+            # mean batch occupancy — the serving analogue of the
+            # pipeline bubble fraction: idle slot-steps are the bubble
+            "occupancy": round(
+                slot_steps / (a["steps"] * self.n_slots), 4)
+            if a["steps"] else None,
+            "latency_ms": telemetry.percentiles(lat_ms),
+            "ttft_ms": telemetry.percentiles(ttft_ms),
+            "step_ms": telemetry.percentiles(a["step_times_ms"]),
+            "prefill_slot_steps": a["prefill_slot_steps"],
+            "decode_slot_steps": a["decode_slot_steps"],
+            "prefill_step_time_s": round(a["prefill_step_time_s"], 4),
+            "decode_step_time_s": round(a["decode_step_time_s"], 4),
+        }
+
+
+def _mutate_slots(slots: SlotState, mask: jax.Array,
+                  new: SlotState) -> SlotState:
+    """Masked row replacement (jitted with the old state donated)."""
+    def sel(old, nw):
+        m = mask.reshape(mask.shape + (1,) * (old.ndim - 1))
+        return jnp.where(m, nw, old)
+
+    return jax.tree_util.tree_map(sel, slots, new)

@@ -1,0 +1,242 @@
+"""Paged KV cache: chunk-aligned page pools + host-side page accounting.
+
+The serving-side reuse of PR 1's packed-buffer machinery: a decode
+engine's KV cache is exactly the allocation problem the packed
+optimizers solved for state — many logically-separate ragged buffers
+(one growing K/V sequence per request) that must live in a few large
+contiguous allocations with fixed-shape kernel access. Here the unit is
+the **page** (PagedAttention/vLLM): ``page_size`` tokens of one layer's
+K or V, owned by at most one request, addressed through a per-request
+page table.
+
+:class:`PagedKVSpec` is the static layout bookkeeping, built on
+``multi_tensor_apply.packing.PackSpec``: the pool is described as a
+pytree of per-layer K/V leaves packed into one flat buffer with
+``chunk_size`` = one page's elements, so **every page is exactly one
+chunk-aligned chunk** — ``analysis.check_pack_spec`` verifies the layout
+mechanically (ROW alignment, non-overlap, chunk tiling), the same gate
+the packed optimizers run under. The working (device) form is the
+structured :class:`KVCacheState` view; :meth:`PagedKVSpec.pack` /
+:meth:`~PagedKVSpec.unpack` map to/from the flat packed buffer
+(snapshots, tests, future sharded layouts).
+
+Pages are **head-major** ``[page, head, token, head_dim]`` so the
+flash-decode kernel's per-head dots need no in-kernel transpose
+(``ops/flash_decode.py``).
+
+Page 0 is reserved as the **garbage page**: page-table entries past a
+request's length (and the write destinations of inactive slots) point at
+it, so fixed-shape kernels and scatters always touch valid memory and
+never need per-slot host branching. :class:`PageAllocator` (host-side
+free list) therefore hands out pages ``1..num_pages-1`` and refuses
+double-frees loudly — the invariant the scheduler property tests pin.
+"""
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..multi_tensor_apply.packing import PackSpec, ROW
+
+
+class KVCacheState(NamedTuple):
+    """Device KV pool: ``pages[layer, 0=k/1=v, page, head, token, dim]``.
+
+    A ``*State`` NamedTuple by convention so the static auditor
+    (``apex_tpu.analysis``) treats it as carried state and enforces its
+    donation into the jitted decode step.
+    """
+
+    pages: jax.Array  # [L, 2, num_pages, n_heads, page_size, head_dim]
+
+
+class PagedKVSpec:
+    """Static paged-KV layout: pool shape, page geometry, PackSpec map.
+
+    ``num_pages`` INCLUDES the reserved garbage page 0, so
+    ``num_pages - 1`` pages are allocatable. ``pages_per_seq`` bounds one
+    request's page-table width (max sequence =
+    ``pages_per_seq * page_size`` tokens).
+    """
+
+    GARBAGE_PAGE = 0
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 *, page_size: int, num_pages: int, pages_per_seq: int,
+                 dtype: Any = jnp.bfloat16):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved garbage "
+                f"page), got {num_pages}")
+        page_elems = num_heads * page_size * head_dim
+        if page_elems % ROW:
+            raise ValueError(
+                f"page ({num_heads} heads x {page_size} tokens x "
+                f"{head_dim} dim = {page_elems} elems) is not ROW-aligned "
+                f"({ROW}): pages would straddle packed-buffer rows — pick "
+                "page_size so heads*page_size*head_dim is a multiple of "
+                f"{ROW}")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.pages_per_seq = int(pages_per_seq)
+        self.dtype = jnp.dtype(dtype)
+        self.page_elems = page_elems
+        self.max_seq_len = self.pages_per_seq * self.page_size
+        # the PackSpec view: per-layer k/v pool leaves, one page = one
+        # chunk. check_pack_spec() on this spec is the mechanical layout
+        # gate (ROW alignment, non-overlap, chunk tiling).
+        template = {
+            f"layer{l:03d}": {
+                "k": jax.ShapeDtypeStruct(self.pool_leaf_shape, self.dtype),
+                "v": jax.ShapeDtypeStruct(self.pool_leaf_shape, self.dtype),
+            }
+            for l in range(self.num_layers)
+        }
+        self.pack_spec = PackSpec(template, align=ROW,
+                                  chunk_size=page_elems)
+
+    @property
+    def pool_leaf_shape(self):
+        """One layer's K (or V) pool: ``[num_pages, heads, page, dim]``."""
+        return (self.num_pages, self.num_heads, self.page_size,
+                self.head_dim)
+
+    @property
+    def n_usable_pages(self) -> int:
+        return self.num_pages - 1  # minus the garbage page
+
+    def page_bytes(self) -> int:
+        return self.page_elems * self.dtype.itemsize
+
+    def cache_bytes(self) -> int:
+        return (self.num_layers * 2 * self.num_pages * self.page_elems
+                * self.dtype.itemsize)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens``."""
+        return -(-int(n_tokens) // self.page_size)
+
+    # -- device state ------------------------------------------------------
+    def init_cache(self) -> KVCacheState:
+        return KVCacheState(pages=jnp.zeros(
+            (self.num_layers, 2) + self.pool_leaf_shape, self.dtype))
+
+    # -- packed-buffer view (PackSpec round trip) --------------------------
+    def _tree(self, cache: KVCacheState):
+        return {
+            f"layer{l:03d}": {"k": cache.pages[l, 0],
+                              "v": cache.pages[l, 1]}
+            for l in range(self.num_layers)
+        }
+
+    def pack(self, cache: KVCacheState) -> jax.Array:
+        """The cache as ONE flat chunk-aligned buffer (page p of layer
+        l's K starts at a chunk boundary by construction)."""
+        return self.pack_spec.pack(self._tree(cache))
+
+    def unpack(self, flat: jax.Array) -> KVCacheState:
+        tree = self.pack_spec.unpack(flat)
+        ks = jnp.stack([tree[f"layer{l:03d}"]["k"]
+                        for l in range(self.num_layers)])
+        vs = jnp.stack([tree[f"layer{l:03d}"]["v"]
+                        for l in range(self.num_layers)])
+        return KVCacheState(pages=jnp.stack([ks, vs], axis=1))
+
+    def __repr__(self):
+        return (f"PagedKVSpec(L={self.num_layers}, heads={self.num_heads},"
+                f" d={self.head_dim}, page={self.page_size}, "
+                f"pages={self.num_pages}, per_seq={self.pages_per_seq}, "
+                f"{self.dtype})")
+
+
+def write_token_kv(pages: jax.Array, layer, k: jax.Array, v: jax.Array,
+                   page_idx: jax.Array, offsets: jax.Array) -> jax.Array:
+    """Scatter one token's K/V per slot into the pool, in place under
+    donation.
+
+    ``pages`` ``[L, 2, P, n, ps, d]``; ``k``/``v`` ``[B, n, d]``;
+    ``page_idx``/``offsets`` ``[B]`` (inactive slots point at the garbage
+    page). One scatter per K and V — the donated-buffer in-place update
+    the packed optimizers use (``input_output_aliases`` there,
+    donation-aliased ``.at[].set`` here).
+    """
+    dt = pages.dtype
+    pages = pages.at[layer, 0, page_idx, :, offsets, :].set(k.astype(dt))
+    pages = pages.at[layer, 1, page_idx, :, offsets, :].set(v.astype(dt))
+    return pages
+
+
+class PageAllocator:
+    """Host-side free list over pages ``1..num_pages-1`` (0 reserved).
+
+    LIFO allocation (hot pages stay hot); loud errors on exhaustion
+    misuse, double-free, and foreign/reserved frees — the leak/double-
+    free invariants the scheduler property tests exercise.
+    """
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._used: set = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._used)
+
+    def alloc(self) -> Optional[int]:
+        """One page id, or None when exhausted."""
+        if not self._free:
+            return None
+        p = self._free.pop()
+        self._used.add(p)
+        return p
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            p = int(p)
+            if p == PagedKVSpec.GARBAGE_PAGE:
+                raise ValueError("freeing the reserved garbage page 0")
+            if p not in self._used:
+                raise ValueError(
+                    f"double-free (or foreign free) of page {p}")
+            self._used.remove(p)
+            self._free.append(p)
+
+    def check(self) -> None:
+        """Invariant: every non-reserved page is exactly once in
+        free-or-used."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("free list contains duplicates")
+        if free & self._used:
+            raise AssertionError(
+                f"pages both free and used: {sorted(free & self._used)}")
+        allp = free | self._used
+        expect = set(range(1, self.num_pages))
+        if allp != expect:
+            raise AssertionError(
+                f"page accounting leak: missing {sorted(expect - allp)}, "
+                f"unknown {sorted(allp - expect)}")
+
+
+def page_table_row(spec: PagedKVSpec, pages: Sequence[int]) -> np.ndarray:
+    """A fixed-width int32 page-table row: the request's pages, then
+    garbage-page fill."""
+    if len(pages) > spec.pages_per_seq:
+        raise ValueError(
+            f"{len(pages)} pages exceed pages_per_seq={spec.pages_per_seq}")
+    row = np.full((spec.pages_per_seq,), PagedKVSpec.GARBAGE_PAGE,
+                  np.int32)
+    if pages:
+        row[:len(pages)] = np.asarray(list(pages), np.int32)
+    return row

@@ -1,0 +1,294 @@
+"""Iteration-level (continuous-batching) scheduler for the decode engine.
+
+Orca-style scheduling: admission and eviction happen **between** decode
+steps, at token granularity, against a fixed-shape slot batch — the
+device program never changes shape, the host just decides which
+requests occupy which slots and which pool pages back them.
+
+Host-side only. The scheduler owns:
+
+- the waiting queue (FIFO admission into free slots);
+- the page accounting (:class:`~.kv_cache.PageAllocator`): pages are
+  allocated **lazily**, one per slot whenever a request's next token
+  crosses a page boundary, and freed on eviction;
+- **preemption**: when the pool is exhausted, the youngest running
+  request is evicted and requeued — its prompt is extended with the
+  tokens it already generated, so on re-admission the (deterministic)
+  prefill replay rebuilds exactly the cache state it lost. vLLM's
+  recompute-mode preemption;
+- the per-slot host mirrors (position, prompt, pages, emitted count)
+  from which the fixed-shape page-table array is rebuilt each step.
+
+The scheduler never touches device arrays — the engine applies its
+decisions through one gated slot-state update (``serving.engine``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Deque, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .kv_cache import PageAllocator, PagedKVSpec, page_table_row
+
+_rid_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``arrival_step`` lets traces stagger admissions deterministically
+    (the continuous-batching tests and the bench leg submit a whole
+    trace up front).
+    """
+
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    arrival_step: int = 0
+    rid: int = dataclasses.field(
+        default_factory=lambda: next(_rid_counter))
+    # engine-filled results / timestamps
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    t_arrival: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_done: Optional[float] = None
+    preemptions: int = 0
+    # seniority, assigned at FIRST admission and stable across
+    # preemptions — the total order that makes preemption terminate
+    # (younger never preempts older, so the most senior request always
+    # progresses)
+    admit_seq: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        if len(self.out_tokens) >= self.max_new_tokens:
+            return True
+        return (self.eos_id is not None and bool(self.out_tokens)
+                and self.out_tokens[-1] == self.eos_id)
+
+
+@dataclasses.dataclass
+class RunningSlot:
+    """Host mirror of one occupied device slot."""
+
+    req: Request
+    prompt: List[int]      # prompt to replay (original + regenerated)
+    pos: int = 0           # tokens already consumed (= tokens in cache)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    admit_seq: int = 0     # admission order (victim selection)
+
+    @property
+    def prefilling(self) -> bool:
+        """True while the NEXT consumed token comes from the prompt."""
+        return self.pos < len(self.prompt)
+
+    def total_len(self) -> int:
+        """Upper bound on this request's cache length."""
+        remaining = self.req.max_new_tokens - len(self.req.out_tokens)
+        return len(self.prompt) + remaining
+
+
+class SchedulerError(RuntimeError):
+    pass
+
+
+class Scheduler:
+    """Continuous batching over ``n_slots`` fixed slots.
+
+    Per step the engine calls, in order: :meth:`admit` (fill free slots
+    from the queue), :meth:`ensure_capacity` (allocate this step's
+    pages, preempting if the pool is dry), :meth:`page_table_array`,
+    then — after the device step — :meth:`advance` and, for finished
+    requests, :meth:`evict`.
+    """
+
+    def __init__(self, spec: PagedKVSpec, n_slots: int,
+                 max_prompt_len: int):
+        self.spec = spec
+        self.n_slots = int(n_slots)
+        self.max_prompt_len = int(max_prompt_len)
+        self.allocator = PageAllocator(spec.num_pages)
+        self.slots: List[Optional[RunningSlot]] = [None] * self.n_slots
+        self.waiting: Deque[Request] = deque()
+        self._admit_seq = itertools.count()
+
+    # -- queue -------------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        self._validate(req, len(req.prompt))
+        self.waiting.append(req)
+
+    def _validate(self, req: Request, prompt_len: int) -> None:
+        if prompt_len < 1:
+            raise SchedulerError(f"request {req.rid}: empty prompt")
+        if prompt_len > self.max_prompt_len:
+            raise SchedulerError(
+                f"request {req.rid}: prompt {prompt_len} exceeds "
+                f"max_prompt_len {self.max_prompt_len}")
+        # recompute-mode preemption replays prompt + generated-so-far as
+        # the new prompt, which can grow to total - 1 tokens; a request
+        # whose replay could not be re-admitted must be refused HERE —
+        # admit() pops before validating, so a late failure would drop
+        # the request from the queue with no way to recover it
+        worst_replay = prompt_len + req.max_new_tokens \
+            - len(req.out_tokens) - 1
+        if worst_replay > self.max_prompt_len:
+            raise SchedulerError(
+                f"request {req.rid}: preemption replay prompt can grow "
+                f"to {worst_replay} (prompt + max_new_tokens - 1), "
+                f"exceeding max_prompt_len {self.max_prompt_len}")
+        total = prompt_len + req.max_new_tokens - len(req.out_tokens)
+        if total > self.spec.max_seq_len:
+            raise SchedulerError(
+                f"request {req.rid}: prompt+max_new = {total} exceeds "
+                f"pages_per_seq*page_size = {self.spec.max_seq_len}")
+        # a request the POOL can never hold must be refused at submit —
+        # admitted, it would preempt every other runner one page at a
+        # time and then sink the whole batch from ensure_capacity
+        if self.spec.pages_for(total) > self.spec.n_usable_pages:
+            raise SchedulerError(
+                f"request {req.rid}: needs {self.spec.pages_for(total)} "
+                f"pages but the pool has {self.spec.n_usable_pages} "
+                "usable — it can never be served (grow num_pages or "
+                "shrink the request)")
+
+    @property
+    def n_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and self.n_active == 0
+
+    def running(self) -> List[Tuple[int, RunningSlot]]:
+        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
+
+    # -- admission ---------------------------------------------------------
+    def admit(self) -> List[Tuple[int, RunningSlot]]:
+        """Move queued requests into free slots (FIFO). Pages are not
+        reserved here — :meth:`ensure_capacity` allocates lazily, and
+        preemption handles a dry pool."""
+        admitted = []
+        for i in range(self.n_slots):
+            if self.slots[i] is not None or not self.waiting:
+                continue
+            req = self.waiting.popleft()
+            if req.admit_seq is None:
+                req.admit_seq = next(self._admit_seq)
+            run = RunningSlot(req=req, prompt=list(req.prompt)
+                              + list(req.out_tokens),
+                              admit_seq=req.admit_seq)
+            self._validate(req, len(run.prompt))
+            self.slots[i] = run
+            admitted.append((i, run))
+        return admitted
+
+    # -- paging ------------------------------------------------------------
+    def _needs_page(self, run: RunningSlot) -> bool:
+        return run.pos // self.spec.page_size >= len(run.pages)
+
+    def ensure_capacity(self) -> List[Request]:
+        """Allocate the page each active slot needs for its next token;
+        preempt when the pool runs dry. Returns the preempted, requeued
+        requests.
+
+        Termination contract: seniority (``Request.admit_seq``) is
+        stable across preemptions, service is oldest-first, and a
+        requester may only preempt strictly YOUNGER victims — when none
+        exists it yields its own slot instead. The most senior request
+        is therefore never displaced, advances every step, and finishes
+        — no preemption ping-pong, however small the pool (requests the
+        pool can never hold were already refused at submit)."""
+        preempted: List[Request] = []
+        for i, run in sorted(self.running(),
+                             key=lambda ir: ir[1].admit_seq):
+            if self.slots[i] is not run:
+                continue  # preempted / yielded earlier in this loop
+            while self.slots[i] is run and self._needs_page(run):
+                page = self.allocator.alloc()
+                if page is not None:
+                    run.pages.append(page)
+                    continue
+                victim = self._pick_victim(exclude=i)
+                if victim is None:
+                    # unreachable for validated requests (_validate
+                    # refuses pages_for(total) > n_usable_pages), so a
+                    # lone runner always fits; defensive for invariant
+                    # breakage only
+                    raise SchedulerError(
+                        "KV pool too small: one request needs "
+                        f"{self.spec.pages_for(run.total_len())} pages "
+                        f"but the pool has {self.spec.n_usable_pages}")
+                vrun = self.slots[victim]
+                if vrun.admit_seq > run.admit_seq:
+                    preempted.append(self._preempt(victim))
+                else:
+                    # every other runner outranks us: yield our slot
+                    # rather than displace a senior request
+                    preempted.append(self._preempt(i))
+        return preempted
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """The youngest-admitted running request (most recent work is
+        the cheapest to redo), never the requester."""
+        cands = [(s.admit_seq, i) for i, s in self.running()
+                 if i != exclude]
+        return max(cands)[1] if cands else None
+
+    def _preempt(self, slot_idx: int) -> Request:
+        run = self.slots[slot_idx]
+        assert run is not None
+        req = run.req
+        req.preemptions += 1
+        self._free_slot(slot_idx)
+        # recompute-mode requeue: replay prompt + already-generated
+        # tokens on readmission (deterministic prefill rebuilds the
+        # exact cache). Requeue at the FRONT: the victim keeps its
+        # priority over later arrivals.
+        self.waiting.appendleft(req)
+        return req
+
+    def _free_slot(self, slot_idx: int) -> None:
+        run = self.slots[slot_idx]
+        if run is None:
+            raise SchedulerError(f"freeing empty slot {slot_idx}")
+        if run.pages:
+            self.allocator.free(run.pages)
+            run.pages = []  # a stale RunningSlot must not look backed
+        self.slots[slot_idx] = None
+
+    def evict(self, slot_idx: int) -> None:
+        """Release a finished request's slot and pages."""
+        self._free_slot(slot_idx)
+
+    # -- device-facing views -----------------------------------------------
+    def page_table_array(self) -> np.ndarray:
+        """``[n_slots, pages_per_seq]`` int32; empty slots are all
+        garbage-page rows."""
+        rows = [
+            page_table_row(self.spec, s.pages if s is not None else [])
+            for s in self.slots
+        ]
+        return np.stack(rows)
+
+    def advance(self, slot_indices: Sequence[int]) -> None:
+        """One token consumed on each given slot."""
+        for i in slot_indices:
+            run = self.slots[i]
+            if run is None:
+                raise SchedulerError(f"advance on empty slot {i}")
+            run.pos += 1
+
+    def check_invariants(self) -> None:
+        """Page accounting must balance exactly (tests)."""
+        self.allocator.check()
+        held = [p for _, s in self.running() for p in s.pages]
+        if len(held) != len(set(held)):
+            raise AssertionError("a page is owned by two slots")
+        if set(held) != set(self.allocator._used):
+            raise AssertionError(
+                f"slot-held pages {sorted(set(held))} != allocator used "
+                f"{sorted(self.allocator._used)}")

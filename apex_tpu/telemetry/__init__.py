@@ -53,6 +53,7 @@ from .recorder import (  # noqa: F401
     NullRecorder,
     RingBufferRecorder,
     is_logging_process,
+    percentiles,
     read_jsonl,
 )
 from .tracing import (  # noqa: F401
@@ -75,7 +76,8 @@ __all__ = [
     "TickTimeline", "analytic_bubble_fraction", "bubble_report",
     "classify_phase", "schedule_ticks", "tick_phases",
     "JsonlRecorder", "MultiRecorder", "NullRecorder",
-    "RingBufferRecorder", "is_logging_process", "read_jsonl",
+    "RingBufferRecorder", "is_logging_process", "percentiles",
+    "read_jsonl",
     "TraceSession", "aggregate_op_times", "breakdown_table",
     "categorize_op", "cost_analysis_breakdown", "parse_xspace_op_times",
     "profile_step", "short_op_name", "trace_session",

@@ -188,6 +188,45 @@ class MultiRecorder(NullRecorder):
             r.close()
 
 
+def percentiles(values, ps=(50, 90, 99), *, field=None):
+    """p50/p90/p99-style reducer over a sequence of numbers OR of JSONL
+    records (dicts; ``field`` names the value key).
+
+    The shared percentile math for everything that folds a telemetry
+    stream — the serving engine's per-request latency summary, the
+    ``serving_throughput`` bench leg, ``tools/health_report.py`` — so no
+    caller hand-rolls interpolation again. Non-numeric / missing /
+    non-finite entries are skipped (JSONL round-trips ``nan``/``inf`` as
+    repr strings, see :func:`_jsonable`). Returns ``{"p50": ..., ...}``
+    (linear interpolation, numpy convention), or ``{}`` when nothing
+    numeric survives.
+    """
+    out_vals = []
+    for v in values:
+        if field is not None:
+            if not isinstance(v, dict):
+                continue
+            v = v.get(field)
+        if isinstance(v, bool) or v is None:
+            continue
+        if isinstance(v, str):
+            try:
+                v = float(v)
+            except ValueError:
+                continue
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            continue
+        if np.isfinite(f):
+            out_vals.append(f)
+    if not out_vals:
+        return {}
+    arr = np.asarray(out_vals, np.float64)
+    return {f"p{int(p) if float(p).is_integer() else p}":
+            float(np.percentile(arr, p)) for p in ps}
+
+
 def read_jsonl(path) -> list:
     """Parse a telemetry JSONL file back into a list of dicts."""
     out = []

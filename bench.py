@@ -699,6 +699,92 @@ def bench_packed_optimizer(iters, hbm_gbps=819.0, hbm_recognised=False):
     }
 
 
+def bench_serving():
+    """Serving legs: paged-KV continuous-batching decode throughput at
+    measured latency percentiles, plus the prefill-vs-decode split.
+
+    Drives ``apex_tpu.serving.ServingEngine`` over a staggered request
+    trace (arrivals spread across the run — real continuous batching,
+    not one static batch): ``serving_throughput`` reports generated
+    tokens/sec with p50/p99 request latency and TTFT (the fixed-latency
+    operating point ``compare_bench`` tracks), and batch **occupancy**
+    — the serving analogue of the pipeline bubble fraction (idle
+    slot-steps are the bubble). ``prefill_decode_split`` attributes
+    slot-steps and wall time to prompt ingestion vs token generation.
+
+    The engine streams per-step + summary records into the bench
+    telemetry JSONL (in-jit drains every 8 steps through the PR-2
+    cond-gated callback + host-side ``serving_step``/``serving_summary``
+    events). Model: the headline 345M shape in bf16 (BENCH_SERVING_LAYERS
+    / BENCH_GPT_LAYERS shrink it for CPU smoke runs).
+    """
+    import numpy as _np
+
+    from apex_tpu.serving import Request, ServingEngine
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    rng = _np.random.default_rng(0)
+    # arrivals staggered across the run so admission/eviction churn is
+    # part of what is measured, not a warmup artifact
+    reqs = [
+        Request(
+            prompt=[int(t) for t in
+                    rng.integers(0, cfg.vocab_size, size=prompt_len)],
+            max_new_tokens=max_new,
+            arrival_step=int(i * max(1, max_new // 2) // max(1, n_slots)))
+        for i in range(n_req)
+    ]
+    eng = ServingEngine(cfg, params, n_slots=n_slots,
+                        telemetry_every=8, sink=telemetry_recorder())
+    eng.generate(reqs)
+    st = eng.last_stats
+    lat, ttft, stp = st["latency_ms"], st["ttft_ms"], st["step_ms"]
+    serving_throughput = {
+        "tokens_per_sec": st["tokens_per_sec"],
+        "p50_ms": lat.get("p50"),
+        "p99_ms": lat.get("p99"),
+        "ttft_p50_ms": ttft.get("p50"),
+        "ttft_p99_ms": ttft.get("p99"),
+        "step_p50_ms": stp.get("p50"),
+        "step_p99_ms": stp.get("p99"),
+        "occupancy": st["occupancy"],
+        "generated_tokens": st["generated_tokens"],
+        "steps": st["steps"],
+        "preemptions": st["preemptions"],
+        "n_requests": n_req,
+        "slots": n_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "layers": layers,
+        "page_size": eng.spec.page_size,
+        "kv_pool_mb": round(eng.spec.cache_bytes() / 2**20, 1),
+    }
+    tot = st["prefill_slot_steps"] + st["decode_slot_steps"]
+    prefill_decode_split = {
+        "prefill_slot_steps": st["prefill_slot_steps"],
+        "decode_slot_steps": st["decode_slot_steps"],
+        "prefill_frac": round(st["prefill_slot_steps"] / tot, 4)
+        if tot else None,
+        "prefill_step_time_s": st["prefill_step_time_s"],
+        "decode_step_time_s": st["decode_step_time_s"],
+    }
+    return {"serving_throughput": serving_throughput,
+            "prefill_decode_split": prefill_decode_split}
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -1028,6 +1114,23 @@ def main() -> None:
             print(f"packed optimizer bench failed: {type(e).__name__}: {e}",
                   file=_sys.stderr)
 
+    # serving legs: continuous-batching decode throughput at measured
+    # latency percentiles + the prefill/decode split. A full engine run
+    # (compile + trace), so fast mode skips it unless BENCH_SERVING=1
+    # forces it (the CPU smoke configuration with BENCH_SERVING_LAYERS;
+    # artifact committed under bench_artifacts/). BENCH_SERVING=0 skips
+    # everywhere.
+    serving = None
+    want_serving = os.environ.get("BENCH_SERVING")
+    if want_serving != "0" and (not fast or want_serving == "1"):
+        try:
+            serving = _retry_transient(bench_serving, tag="serving legs")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"serving bench failed: {type(e).__name__}: {e}",
+                  file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -1094,6 +1197,8 @@ def main() -> None:
         "bert_large_lamb": bert,
         "resnet50_o2": resnet,
         "packed_optimizer": packed_opt,
+        "serving_throughput": (serving or {}).get("serving_throughput"),
+        "prefill_decode_split": (serving or {}).get("prefill_decode_split"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

@@ -27,6 +27,31 @@ def _mesh():
     return Mesh(np.array(jax.devices()), ("data",))
 
 
+@pytest.fixture(scope="module")
+def sharded_packed_precondition():
+    """Gate for the sharded tests: the ROADMAP sharded-packed follow-on
+    (run ``packed_adam_apply`` on the ``(shard_size,)`` shard inside
+    shard_map) requires the packed layout to split into DP equal
+    ROW-aligned shards — machine-checked by
+    ``analysis.check_pack_spec(spec, shard_count=dp)`` (PR 4). The spec
+    is built through ``packed_init`` — the ACTUAL constructor the packed
+    upgrade would use over these params, default chunking — so a layout
+    change in `_packed.py`/`packing.py` that breaks the precondition
+    (chunk no longer DP-divisible into ROW-aligned shards, padding
+    scheme change, offset misalignment) fails HERE, by name, before it
+    silently blocks the packed upgrade."""
+    from apex_tpu.analysis import check_pack_spec
+    from apex_tpu.optimizers._packed import packed_init
+
+    params = _toy_params(jax.random.PRNGKey(0))
+    spec = packed_init(params).spec
+    findings = check_pack_spec(spec, shard_count=DP)
+    assert not findings, (
+        "sharded-packed precondition violated:\n"
+        + "\n".join(f"{f.code}: {f.message}" for f in findings))
+    return spec
+
+
 def _toy_params(key, dtype=jnp.float32):
     k1, k2, k3 = jax.random.split(key, 3)
     return {
@@ -77,7 +102,8 @@ def _ref_train_step(opt):
 
 
 @pytest.mark.parametrize("adam_w_mode,weight_decay", [(True, 0.01), (False, 0.0)])
-def test_dist_adam_matches_fused_adam(adam_w_mode, weight_decay):
+def test_dist_adam_matches_fused_adam(adam_w_mode, weight_decay,
+                                      sharded_packed_precondition):
     """dp=8 sharded step == single-device FusedAdam, several steps
     (reference test_dist_adam.py main equivalence)."""
     mesh = _mesh()
@@ -107,7 +133,7 @@ def test_dist_adam_matches_fused_adam(adam_w_mode, weight_decay):
     assert int(d_state.step) == 5
 
 
-def test_dist_adam_state_is_sharded():
+def test_dist_adam_state_is_sharded(sharded_packed_precondition):
     """ZeRO property: each device holds 1/dp of each state buffer."""
     mesh = _mesh()
     params = _toy_params(jax.random.PRNGKey(1))
@@ -126,7 +152,7 @@ def test_dist_adam_state_is_sharded():
         )
 
 
-def test_dist_adam_overflow_skips_step():
+def test_dist_adam_overflow_skips_step(sharded_packed_precondition):
     mesh = _mesh()
     params = _toy_params(jax.random.PRNGKey(3))
     dist = DistributedFusedAdam(lr=1e-2, distributed_size=DP)
@@ -149,7 +175,7 @@ def test_dist_adam_overflow_skips_step():
     assert int(new_state.step) == 0
 
 
-def test_dist_adam_grad_scale_and_clip():
+def test_dist_adam_grad_scale_and_clip(sharded_packed_precondition):
     """grad_scale unscaling + max_grad_norm clip match a manual reference."""
     mesh = _mesh()
     params = _toy_params(jax.random.PRNGKey(5))
@@ -187,7 +213,8 @@ def test_dist_adam_grad_scale_and_clip():
 
 
 @pytest.mark.parametrize("format", ["v1", "v2"])
-def test_dist_adam_checkpoint_roundtrip(format):
+def test_dist_adam_checkpoint_roundtrip(format,
+                                        sharded_packed_precondition):
     """Sharded state_dict v1/v2 round-trips and training continues identically
     (reference sharded checkpoints distributed_fused_adam.py:2956-3555)."""
     mesh = _mesh()
@@ -212,7 +239,8 @@ def test_dist_adam_checkpoint_roundtrip(format):
     assert int(s_b.step) == 2
 
 
-def test_dist_adam_bf16_params_master_weights():
+def test_dist_adam_bf16_params_master_weights(
+        sharded_packed_precondition):
     """bf16 model params + fp32 sharded masters: matches FusedAdam with
     master_weights=True."""
     mesh = _mesh()
@@ -248,7 +276,8 @@ def test_dist_adam_bf16_params_master_weights():
 
 
 @pytest.mark.parametrize("use_nvlamb,weight_decay", [(False, 0.01), (True, 0.0)])
-def test_dist_lamb_matches_fused_lamb(use_nvlamb, weight_decay):
+def test_dist_lamb_matches_fused_lamb(use_nvlamb, weight_decay,
+                                      sharded_packed_precondition):
     """dp=8 sharded LAMB == single-device FusedLAMB (trust ratios exact via
     segment-sum psum)."""
     mesh = _mesh()
@@ -276,7 +305,7 @@ def test_dist_lamb_matches_fused_lamb(use_nvlamb, weight_decay):
         )
 
 
-def test_dist_lamb_checkpoint_roundtrip():
+def test_dist_lamb_checkpoint_roundtrip(sharded_packed_precondition):
     mesh = _mesh()
     params = _toy_params(jax.random.PRNGKey(12))
     dist = DistributedFusedLAMB(lr=1e-2, distributed_size=DP)

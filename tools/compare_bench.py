@@ -35,6 +35,10 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("packed_opt_vs_pytree", "packed_optimizer.vs_pytree", True),
     ("fp8_gemm_vs_bf16", "fp8_e4m3_gemm_vs_bf16", True),
     ("fp8_model_tokens_per_sec", "gpt2_345m_fp8.tokens_per_sec", True),
+    ("serving_tokens_per_sec", "serving_throughput.tokens_per_sec", True),
+    ("serving_p50_ms", "serving_throughput.p50_ms", False),
+    ("serving_p99_ms", "serving_throughput.p99_ms", False),
+    ("serving_occupancy", "serving_throughput.occupancy", True),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
     ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
 )

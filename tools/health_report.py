@@ -58,6 +58,7 @@ def health_from_records(records: Iterable[dict]) -> dict:
           "run": {...}                  # last metrics-record snapshot
         }
     """
+    step_stamps: dict = defaultdict(list)
     leaves: dict = defaultdict(lambda: {
         "first_bad_step": None, "nonfinite_events": 0,
         "last_norm": None, "last_maxabs": None, "max_maxabs": None})
@@ -124,8 +125,27 @@ def health_from_records(records: Iterable[dict]) -> dict:
             run = {k: r[k] for k in (
                 "step", "loss", "loss_scale", "overflow_skips",
                 "scale_growths", "grad_norm") if k in r}
+        elif ev == "step" and isinstance(r.get("t_dispatch"), (int, float)):
+            step_stamps[r.get("leg") or "?"].append(float(r["t_dispatch"]))
+
+    # per-leg percentiles over gaps between the bench per-step
+    # t_dispatch stamps, via the shared telemetry.percentiles reducer
+    # (no hand-rolled percentile math here or in the serving leg).
+    # These are DISPATCH intervals — the stamps are taken host-side
+    # with no sync (bench.py), so on an async backend they measure how
+    # fast the host issues steps, not how long the device takes; true
+    # step time is the leg summary's step_ms.
+    from apex_tpu.telemetry import percentiles
+
+    dispatch_interval_ms = {
+        leg: percentiles([1e3 * (b - a) for a, b in zip(ts, ts[1:])])
+        for leg, ts in step_stamps.items() if len(ts) >= 2
+    }
+    dispatch_interval_ms = {
+        k: v for k, v in dispatch_interval_ms.items() if v}
 
     return {
+        "dispatch_interval_ms": dispatch_interval_ms,
         "steps_seen": steps_seen,
         "first_bad_step": first_bad,
         "anomalies": anomalies,
@@ -166,6 +186,10 @@ def render_report(h: dict) -> str:
     if h["run"]:
         out.append("last metrics: " + ", ".join(
             f"{k}={_fmt(v)}" for k, v in h["run"].items()))
+    if h.get("dispatch_interval_ms"):
+        for leg, ps in sorted(h["dispatch_interval_ms"].items()):
+            out.append(f"dispatch interval [{leg}]: " + ", ".join(
+                f"{k}={_fmt(v)}ms" for k, v in ps.items()))
     if h["leaves"]:
         out.append("\nper-tensor health (grads)")
         rows = [
