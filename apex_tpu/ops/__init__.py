@@ -34,6 +34,12 @@ from .flash_attention import (  # noqa: F401
     flash_attention_sbhd,
     flash_attention_available,
 )
+from .fused_block import (  # noqa: F401
+    bias_dropout_residual,
+    bias_gelu,
+    fused_block_available,
+    residual_add_layer_norm,
+)
 from .flash_decode import (  # noqa: F401
     flash_decode,
     flash_decode_available,

@@ -19,6 +19,37 @@ functions used by ``apex_tpu.normalization`` — each with a
 ``memory_efficient`` mode that saves the *output* and re-derives the
 normalized input in backward (reference ``apex/normalization/
 fused_layer_norm.py`` ``memory_efficient`` flag).
+
+Kernel-dispatch decision table (``_use_pallas``, also consulted by the
+fused-block tail kernels in ``ops/fused_block.py`` via ``fused=True``):
+
+===========================  =========================  ==================
+condition                    plain LN / RMSNorm         fused tails
+                                                        (residual+LN,
+                                                        bias_gelu, ...)
+===========================  =========================  ==================
+``APEX_TPU_DISABLE_PALLAS``  XLA fallback               XLA fallback
+``interpret=True``           Pallas interpreter         Pallas interpreter
+TPU, hidden % 128 == 0       XLA **by default** (XLA's  **Pallas by
+                             own LN fusion measured     default** — the
+                             ~4x faster on v5e;         fused tail
+                             ``APEX_TPU_FORCE_          replaces several
+                             PALLAS_LN`` overrides)     XLA sweeps XLA
+                                                        does NOT fuse
+                                                        (BENCH_r05: 42.7%
+                                                        elementwise +
+                                                        17.7% data
+                                                        movement), a
+                                                        different
+                                                        roofline from one
+                                                        row-normalisation
+non-TPU / ragged hidden      XLA fallback               XLA fallback
+===========================  =========================  ==================
+
+The asymmetry is deliberate: losing to XLA on a *single* fused LN says
+nothing about a kernel that replaces bias-add + dropout + residual-add +
+LN round trips with one HBM sweep. Gating both on the same
+force-flag (the pre-PR-9 behaviour) silently disabled the fused path.
 """
 from __future__ import annotations
 
@@ -36,18 +67,25 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
-def _use_pallas(hidden: int, interpret: bool) -> bool:
+def _use_pallas(hidden: int, interpret: bool, *, fused: bool = False) -> bool:
+    """Kernel-dispatch gate, shared with ``ops/fused_block.py``
+    (``fused=True``). See the decision table in the module docstring:
+    the "XLA LN wins" default applies ONLY to the plain-LN path —
+    gating the fused residual+LN tail on the same flag would silently
+    disable a kernel with a different roofline."""
     if os.environ.get("APEX_TPU_DISABLE_PALLAS"):
         return False
     if interpret:
         return True
-    # Honest default: on v5e, XLA's fused LN beats this hand-written kernel
-    # by ~4x at transformer shapes (measured in-model: 279 vs 301 ms/step
-    # for GPT-2 345M) — row-normalisation is exactly the fusion XLA already
-    # does well. The Pallas kernel is kept for interpret-mode parity tests
-    # and for experimentation via APEX_TPU_FORCE_PALLAS_LN.
-    if not os.environ.get("APEX_TPU_FORCE_PALLAS_LN"):
-        return False
+    if not fused:
+        # Honest default: on v5e, XLA's fused LN beats this hand-written
+        # kernel by ~4x at transformer shapes (measured in-model: 279 vs
+        # 301 ms/step for GPT-2 345M) — row-normalisation is exactly the
+        # fusion XLA already does well. The Pallas kernel is kept for
+        # interpret-mode parity tests and for experimentation via
+        # APEX_TPU_FORCE_PALLAS_LN.
+        if not os.environ.get("APEX_TPU_FORCE_PALLAS_LN"):
+            return False
     return (
         pltpu is not None
         and jax.default_backend() == "tpu"

@@ -45,6 +45,14 @@ from ...ops.flash_attention import (
     flash_attention_available,
     flash_attention_sbhd,
 )
+from ...ops.fused_block import (
+    BIAS_DROPOUT_RESIDUAL_FWD,
+    BIAS_GELU_FWD,
+    RESIDUAL_LN_FWD,
+    bias_dropout_residual,
+    bias_gelu,
+    residual_add_layer_norm,
+)
 from ...telemetry import numerics as _numerics
 
 Pytree = Any
@@ -70,7 +78,12 @@ class GPTConfig:
     sequence_parallel: bool = False
     apply_query_key_layer_scaling: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
-    recompute_granularity: Optional[str] = None  # None | "full" | "selective"
+    # None | "full" | "selective" | "selective_elementwise" — see
+    # transformer_block. "selective_elementwise" additionally pins the
+    # fused-block tail kernel outputs as saveable, so backward replays
+    # only the cheap unfused elementwise remainder (pairs with
+    # fused_block=True; docs/fused_block.md has the decision table).
+    recompute_granularity: Optional[str] = None
     # Layer-scan unroll factor. 1 = one compiled layer body (fast compile,
     # the default for tests/virtual meshes); -1 = fully unrolled whatever
     # num_layers is (the single-chip perf configuration: removes the
@@ -81,6 +94,18 @@ class GPTConfig:
     # None = auto (Pallas flash attention when available & applicable);
     # True forces it (errors if inapplicable); False forces the XLA path.
     use_flash_attention: Optional[bool] = None
+    # Fused transformer-block tail (ops/fused_block.py): the projection
+    # GEMMs run bias-free and the tails collapse into single sweeps —
+    # bias+GeLU on the MLP up-projection, bias+dropout+residual on the
+    # MLP output, bias+dropout+residual+LN on the attention output (the
+    # post-LN reads the residual straight from VMEM). Hidden dropout
+    # then uses counter-hash dropout (seeded from the step key) instead
+    # of bernoulli-from-key — same rate, different (deterministic)
+    # stream. fused_block_interpret runs the kernels under the Pallas
+    # interpreter (CPU parity tests; off-TPU without it the ops fall
+    # back to identical-math XLA).
+    fused_block: bool = False
+    fused_block_interpret: bool = False
     # Context parallelism (long context): name of a mesh axis the SEQUENCE
     # is sharded over end-to-end — attention runs as ring attention over
     # that axis (apex_tpu.transformer.context_parallel). Composable with
@@ -289,10 +314,14 @@ def parallel_attention(
     deterministic: bool,
     layer_number: Optional[jax.Array] = None,
     fp8=None,  # {name: (Fp8DenseState, carrier)} for qkv/proj
+    fuse_tail: bool = False,
 ):
     """Self-attention (reference ``ParallelAttention``
     ``standalone_transformer_lm.py:210-400``): column-parallel fused QKV,
-    head-parallel scaled-masked softmax, row-parallel output projection."""
+    head-parallel scaled-masked softmax, row-parallel output projection.
+
+    ``fuse_tail=True`` returns the projection WITHOUT ``proj_b`` — the
+    caller fuses the bias into the block tail (fused_block path)."""
     s, b, _ = hidden.shape
     tp = cfg.tensor_model_parallel_size if axis_name is not None else 1
     np_local = cfg.num_attention_heads // tp
@@ -395,7 +424,8 @@ def parallel_attention(
             scale=1.0 / (hn ** 0.5),
         ).astype(hidden.dtype)
         ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(s, b, np_local * hn)
-        return _attn_out_proj(cfg, lp, ctx, axis_name, fp8, new_fp8)
+        return _attn_out_proj(cfg, lp, ctx, axis_name, fp8, new_fp8,
+                              fuse_tail)
 
     # --- flash attention path (Pallas, O(s) memory) ---------------------
     # Replaces the materialised-[b,np,sq,sk] scores below when applicable:
@@ -516,18 +546,24 @@ def parallel_attention(
         ).astype(hidden.dtype)
         ctx = ctx.reshape(s, b, np_local * hn)
 
-    return _attn_out_proj(cfg, lp, ctx, axis_name, fp8, new_fp8)
+    return _attn_out_proj(cfg, lp, ctx, axis_name, fp8, new_fp8,
+                          fuse_tail)
 
 
-def _attn_out_proj(cfg, lp, ctx, axis_name, fp8=None, new_fp8=None):
+def _attn_out_proj(cfg, lp, ctx, axis_name, fp8=None, new_fp8=None,
+                   fuse_tail=False):
     """Row-parallel (or dense) attention output projection, shared by the
     flash/XLA and ring-attention context-parallel paths. With fp8 active,
-    returns ``(out, new_fp8)`` carrying the rolled qkv/proj states."""
+    returns ``(out, new_fp8)`` carrying the rolled qkv/proj states.
+    ``fuse_tail`` omits ``proj_b`` (fused into the block tail by the
+    caller — bias rides the single fused sweep, not the GEMM epilogue)."""
+    bias = None if fuse_tail else lp["proj_b"]
     if fp8 is not None and axis_name is not None:
         st, car = fp8["proj"]
         out, _, new_fp8["proj"] = row_parallel_linear(
             ctx, lp["proj_w"].astype(ctx.dtype),
-            lp["proj_b"].astype(ctx.dtype), axis_name=axis_name,
+            None if bias is None else bias.astype(ctx.dtype),
+            axis_name=axis_name,
             input_is_parallel=True,
             sequence_parallel_enabled=cfg.sequence_parallel,
             fp8_state=st, fp8_grad_carrier=car,
@@ -538,18 +574,20 @@ def _attn_out_proj(cfg, lp, ctx, axis_name, fp8=None, new_fp8=None):
     if fp8 is not None:
         out, new_fp8["proj"] = _fp8_dense(
             cfg, fp8, "proj", ctx, lp["proj_w"].astype(ctx.dtype),
-            lp["proj_b"])
+            bias)
         return out, new_fp8
     if axis_name is not None:
         out, _ = row_parallel_linear(
             ctx, lp["proj_w"].astype(ctx.dtype),
-            lp["proj_b"].astype(ctx.dtype), axis_name=axis_name,
+            None if bias is None else bias.astype(ctx.dtype),
+            axis_name=axis_name,
             input_is_parallel=True,
             sequence_parallel_enabled=cfg.sequence_parallel,
         )
     else:
-        out = (jnp.einsum("sbo,ho->sbh", ctx, lp["proj_w"].astype(ctx.dtype))
-               + lp["proj_b"].astype(ctx.dtype))
+        out = jnp.einsum("sbo,ho->sbh", ctx, lp["proj_w"].astype(ctx.dtype))
+        if bias is not None:
+            out = out + bias.astype(ctx.dtype)
     return out
 
 
@@ -559,27 +597,46 @@ def parallel_mlp(
     hidden: jax.Array,
     axis_name: Optional[str],
     fp8=None,  # {name: (Fp8DenseState, carrier)} for fc1/fc2
+    fuse_tail: bool = False,
 ):
     """Reference ``ParallelMLP`` (``standalone_transformer_lm.py:89-130``):
     column-parallel h→4h, fused bias-GeLU, row-parallel 4h→h. With fp8
-    active, returns ``(out, new_fp8)``."""
+    active, returns ``(out, new_fp8)``.
+
+    ``fuse_tail=True`` is the fused-block MLP: fc1 runs bias-free and the
+    bias+GeLU epilogue is the :func:`apex_tpu.ops.bias_gelu` kernel (one
+    sweep over the [s, b, 4h] intermediate — the ``fused_dense_cuda``
+    GEMM+bias+GeLU shape); fc2 also runs bias-free and the caller fuses
+    ``fc2_b`` into the block-tail bias+dropout+residual sweep.
+    """
+
+    def act(inter):
+        if fuse_tail:
+            return bias_gelu(inter, lp["fc1_b"].astype(inter.dtype),
+                             interpret=cfg.fused_block_interpret)
+        return jax.nn.gelu(inter, approximate=True)
+
+    fc1_b = None if fuse_tail else lp["fc1_b"]
+    fc2_b = None if fuse_tail else lp["fc2_b"]
     new_fp8 = {}
     if fp8 is not None and axis_name is not None:
         st1, car1 = fp8["fc1"]
         inter, _, new_fp8["fc1"] = column_parallel_linear(
             hidden, lp["fc1_w"].astype(hidden.dtype),
-            lp["fc1_b"].astype(hidden.dtype), axis_name=axis_name,
+            None if fc1_b is None else fc1_b.astype(hidden.dtype),
+            axis_name=axis_name,
             gather_output=False,
             sequence_parallel_enabled=cfg.sequence_parallel,
             fp8_state=st1, fp8_grad_carrier=car1,
             fp8_amax_reduction_axes=cfg.fp8_amax_reduction_axes,
             fp8_margin=cfg.fp8_margin,
         )
-        inter = jax.nn.gelu(inter, approximate=True)
+        inter = act(inter)
         st2, car2 = fp8["fc2"]
         out, _, new_fp8["fc2"] = row_parallel_linear(
             inter, lp["fc2_w"].astype(inter.dtype),
-            lp["fc2_b"].astype(inter.dtype), axis_name=axis_name,
+            None if fc2_b is None else fc2_b.astype(inter.dtype),
+            axis_name=axis_name,
             input_is_parallel=True,
             sequence_parallel_enabled=cfg.sequence_parallel,
             fp8_state=st2, fp8_grad_carrier=car2,
@@ -590,32 +647,37 @@ def parallel_mlp(
     if fp8 is not None:
         inter, new_fp8["fc1"] = _fp8_dense(
             cfg, fp8, "fc1", hidden, lp["fc1_w"].astype(hidden.dtype),
-            lp["fc1_b"])
-        inter = jax.nn.gelu(inter, approximate=True)
+            fc1_b)
+        inter = act(inter)
         out, new_fp8["fc2"] = _fp8_dense(
             cfg, fp8, "fc2", inter, lp["fc2_w"].astype(inter.dtype),
-            lp["fc2_b"])
+            fc2_b)
         return out, new_fp8
     if axis_name is not None:
         inter, _ = column_parallel_linear(
             hidden, lp["fc1_w"].astype(hidden.dtype),
-            lp["fc1_b"].astype(hidden.dtype), axis_name=axis_name,
+            None if fc1_b is None else fc1_b.astype(hidden.dtype),
+            axis_name=axis_name,
             gather_output=False,
             sequence_parallel_enabled=cfg.sequence_parallel,
         )
-        inter = jax.nn.gelu(inter, approximate=True)
+        inter = act(inter)
         out, _ = row_parallel_linear(
             inter, lp["fc2_w"].astype(inter.dtype),
-            lp["fc2_b"].astype(inter.dtype), axis_name=axis_name,
+            None if fc2_b is None else fc2_b.astype(inter.dtype),
+            axis_name=axis_name,
             input_is_parallel=True,
             sequence_parallel_enabled=cfg.sequence_parallel,
         )
         return out
-    inter = (jnp.einsum("sbh,oh->sbo", hidden, lp["fc1_w"].astype(hidden.dtype))
-             + lp["fc1_b"].astype(hidden.dtype))
-    inter = jax.nn.gelu(inter, approximate=True)
-    return (jnp.einsum("sbo,ho->sbh", inter, lp["fc2_w"].astype(hidden.dtype))
-            + lp["fc2_b"].astype(hidden.dtype))
+    inter = jnp.einsum("sbh,oh->sbo", hidden, lp["fc1_w"].astype(hidden.dtype))
+    if fc1_b is not None:
+        inter = inter + fc1_b.astype(hidden.dtype)
+    inter = act(inter)
+    out = jnp.einsum("sbo,ho->sbh", inter, lp["fc2_w"].astype(hidden.dtype))
+    if fc2_b is not None:
+        out = out + fc2_b.astype(hidden.dtype)
+    return out
 
 
 def transformer_layer(
@@ -638,6 +700,13 @@ def transformer_layer(
     unless a ``numerics.activation_watch`` context is active at trace
     time; under a differentiated layer scan the taps fire on
     forward-only runs, the same restriction as the pipeline tick hooks).
+
+    With ``cfg.fused_block`` the two sublayer tails run as the
+    ``ops/fused_block.py`` single-sweep kernels: the attention tail is
+    ``residual_add_layer_norm`` (proj bias + hidden dropout + residual
+    add + the MLP's pre-LN, one sweep), the MLP tail is
+    ``bias_dropout_residual``; the taps then observe the bias-free
+    branch outputs (same tap keys, the bias moves into the fused sweep).
     """
     with jax.named_scope("apex_tpu.transformer_layer"):
         dt = hidden.dtype
@@ -651,7 +720,7 @@ def transformer_layer(
         ).astype(dt)
         attn = parallel_attention(
             cfg, lp, ln1, attention_mask, axis_name, k1, deterministic,
-            layer_number, fp8=fp8_l,
+            layer_number, fp8=fp8_l, fuse_tail=cfg.fused_block,
         )
         new_fp8 = {}
         if fp8_l is not None:
@@ -659,24 +728,56 @@ def transformer_layer(
             new_fp8.update(attn_fp8)
         attn = _numerics.tap(
             "apex_tpu.transformer_layer/attn", attn, layer=layer_number)
-        hidden = (hidden + _dropout(attn, cfg.hidden_dropout, k3,
-                                   deterministic)).astype(dt)
 
-        ln2 = fused_layer_norm(
-            hidden.astype(jnp.float32), lp["post_ln_w"].astype(jnp.float32),
-            lp["post_ln_b"].astype(jnp.float32), eps=cfg.layernorm_epsilon,
-        ).astype(dt)
-        mlp_out = parallel_mlp(cfg, lp, ln2, axis_name, fp8=fp8_l)
+        if cfg.fused_block:
+            p = (0.0 if deterministic or k3 is None
+                 else float(cfg.hidden_dropout))
+            hidden, ln2 = residual_add_layer_norm(
+                attn, lp["proj_b"].astype(dt), hidden,
+                lp["post_ln_w"], lp["post_ln_b"],
+                eps=cfg.layernorm_epsilon, dropout_p=p,
+                seed=_hash_dropout_seed(k3, p),
+                interpret=cfg.fused_block_interpret,
+            )
+        else:
+            hidden = (hidden + _dropout(attn, cfg.hidden_dropout, k3,
+                                        deterministic)).astype(dt)
+            ln2 = fused_layer_norm(
+                hidden.astype(jnp.float32),
+                lp["post_ln_w"].astype(jnp.float32),
+                lp["post_ln_b"].astype(jnp.float32),
+                eps=cfg.layernorm_epsilon,
+            ).astype(dt)
+        mlp_out = parallel_mlp(cfg, lp, ln2, axis_name, fp8=fp8_l,
+                               fuse_tail=cfg.fused_block)
         if fp8_l is not None:
             mlp_out, mlp_fp8 = mlp_out
             new_fp8.update(mlp_fp8)
         mlp_out = _numerics.tap(
             "apex_tpu.transformer_layer/mlp", mlp_out, layer=layer_number)
-        out = (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
-                                 deterministic)).astype(dt)
+        if cfg.fused_block:
+            p = (0.0 if deterministic or k2 is None
+                 else float(cfg.hidden_dropout))
+            out = bias_dropout_residual(
+                mlp_out, lp["fc2_b"].astype(dt), hidden,
+                dropout_p=p, seed=_hash_dropout_seed(k2, p),
+                interpret=cfg.fused_block_interpret,
+            )
+        else:
+            out = (hidden + _dropout(mlp_out, cfg.hidden_dropout, k2,
+                                     deterministic)).astype(dt)
     if fp8_l is not None:
         return out, new_fp8
     return out
+
+
+def _hash_dropout_seed(key, p: float):
+    """int32 seed for the fused tails' counter-hash dropout, derived from
+    the step's dropout key (the flash-attention in-kernel dropout seed
+    contract). None when dropout is off."""
+    if p <= 0.0 or key is None:
+        return None
+    return jax.random.randint(key, (), -(2 ** 31), 2 ** 31 - 1, jnp.int32)
 
 
 # pallas kernels whose forward outputs 'selective' recompute stores: the
@@ -695,11 +796,47 @@ _SELECTIVE_SAVEABLE_KERNELS = frozenset({
 def _selective_policy(prim, *args, **kwargs):
     """Megatron 'selective' recompute, flash-aware: save weight-GEMM
     outputs plus the allowlisted O(s)-output pallas kernels above."""
+    return _policy_with_saveable_kernels(
+        prim, _SELECTIVE_SAVEABLE_KERNELS, *args, **kwargs)
+
+
+def _pallas_kernel_name(params) -> Optional[str]:
+    """Kernel name off a traced pallas_call's params. Modern jaxprs carry
+    it in ``name_and_src_info`` — the bare ``"name"`` param the original
+    policy matched on no longer exists there, which silently reduced
+    'selective' to dots-only saving (every kernel replayed in backward)."""
+    nsi = params.get("name_and_src_info")
+    if nsi is not None and getattr(nsi, "name", None):
+        return nsi.name
+    return params.get("name")
+
+
+def _policy_with_saveable_kernels(prim, kernels, *args, **kwargs):
     if getattr(prim, "name", "") == "pallas_call":
-        return kwargs.get("name") in _SELECTIVE_SAVEABLE_KERNELS
+        return _pallas_kernel_name(kwargs) in kernels
     return jax.checkpoint_policies.dots_with_no_batch_dims_saveable(
         prim, *args, **kwargs
     )
+
+
+# the fused-block tail kernels' forward outputs are 'selective_elementwise'
+# saveable on top of the selective set: each is the collapsed form of the
+# exact elementwise chain the round-5 profile pays 42.7% for — storing the
+# single fused output means backward replays only the cheap UNFUSED
+# remainder (embedding adds, casts) instead of the whole layer tail
+_FUSED_BLOCK_SAVEABLE_KERNELS = frozenset({
+    BIAS_GELU_FWD, BIAS_DROPOUT_RESIDUAL_FWD, RESIDUAL_LN_FWD,
+})
+
+
+def _selective_elementwise_policy(prim, *args, **kwargs):
+    """The fused-block remat policy: matmul/attention/norm outputs plus
+    the fused tail-kernel outputs are saved; only unfused elementwise
+    remains to replay. Pairs with ``GPTConfig.fused_block`` (without the
+    fused kernels in the trace it degrades to exactly 'selective')."""
+    return _policy_with_saveable_kernels(
+        prim, _SELECTIVE_SAVEABLE_KERNELS | _FUSED_BLOCK_SAVEABLE_KERNELS,
+        *args, **kwargs)
 
 
 def transformer_block(
@@ -719,7 +856,10 @@ def transformer_block(
     the reference's ``--recompute-granularity full`` activation
     checkpointing (``tensor_parallel/random.py:237``); ``"selective"``
     keeps matmul outputs and replays only the cheap elementwise/softmax work
-    (the reference's ``--recompute-granularity selective``).
+    (the reference's ``--recompute-granularity selective``);
+    ``"selective_elementwise"`` additionally keeps the fused-block tail
+    kernel outputs (pairs with ``cfg.fused_block`` — backward then replays
+    only the unfused elementwise remainder).
 
     With ``fp8_states``/``fp8_carriers`` the per-layer state slices ride
     the scan's xs and the rolled states come back as ys: returns
@@ -755,10 +895,13 @@ def transformer_block(
         body = jax.checkpoint(body)
     elif cfg.recompute_granularity == "selective":
         body = jax.checkpoint(body, policy=_selective_policy)
+    elif cfg.recompute_granularity == "selective_elementwise":
+        body = jax.checkpoint(body, policy=_selective_elementwise_policy)
     elif cfg.recompute_granularity is not None:
         raise ValueError(
             f"unknown recompute_granularity "
-            f"{cfg.recompute_granularity!r}: use None, 'full' or 'selective'"
+            f"{cfg.recompute_granularity!r}: use None, 'full', 'selective' "
+            f"or 'selective_elementwise'"
         )
 
     unroll = int(cfg.layer_unroll)

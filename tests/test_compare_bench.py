@@ -155,3 +155,75 @@ def test_cli_trajectory_all_unparseable_fails_loudly(tmp_path, capsys):
     assert main(paths + ["--trajectory"]) == 2
     report = json.loads(capsys.readouterr().out)
     assert len(report["skipped_unparseable"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# op-breakdown category diffing (ISSUE-9)
+# ---------------------------------------------------------------------------
+
+def _bench_with_categories(elementwise, data_movement, matmul):
+    b = _bench()
+    other = 100.0 - elementwise - data_movement - matmul
+    b["op_breakdown"] = {
+        "source": "xplane",
+        "categories": {
+            "fusion(elementwise)": {"ms_per_step": 1.0, "pct": elementwise},
+            "data-movement": {"ms_per_step": 1.0, "pct": data_movement},
+            "matmul/conv": {"ms_per_step": 1.0, "pct": matmul},
+            "attention-kernel": {"ms_per_step": 1.0, "pct": other},
+        },
+    }
+    return b
+
+
+def test_category_regression_flagged_over_2pp():
+    base = _bench_with_categories(20.0, 10.0, 40.0)
+    new = _bench_with_categories(25.0, 10.0, 35.0)  # elementwise +5pp
+    rep = compare(base, new)
+    regressed = {r["leg"] for r in rep["regressions"]}
+    assert "op_category:fusion(elementwise)" in regressed
+    (entry,) = [r for r in rep["regressions"]
+                if r["leg"] == "op_category:fusion(elementwise)"]
+    assert entry["delta_pp"] == 5.0
+    # the full shift table rides the report
+    shifts = {s["category"]: s["delta_pp"]
+              for s in rep["op_categories"]["shift"]}
+    assert shifts["matmul/conv"] == -5.0
+
+
+def test_compute_category_growth_not_flagged():
+    # winning back elementwise time NECESSARILY grows the matmul share —
+    # that is the point of the fused tails, not a regression
+    base = _bench_with_categories(42.0, 18.0, 12.0)
+    new = _bench_with_categories(25.0, 10.0, 37.0)  # the ISSUE-9 target
+    rep = compare(base, new)
+    assert not [r for r in rep["regressions"]
+                if r["leg"].startswith("op_category:")]
+
+
+def test_category_shift_within_threshold_not_flagged():
+    base = _bench_with_categories(20.0, 10.0, 40.0)
+    new = _bench_with_categories(21.5, 10.0, 38.5)  # +1.5pp < 2pp
+    rep = compare(base, new)
+    assert not [r for r in rep["regressions"]
+                if r["leg"].startswith("op_category:")]
+
+
+def test_missing_breakdown_skips_category_diff():
+    rep = compare(_bench(), _bench_with_categories(20.0, 10.0, 40.0))
+    assert rep["op_categories"] is None
+    # cost_analysis captures (CPU) publish empty categories — also skipped
+    empty = _bench()
+    empty["op_breakdown"] = {"source": "cost_analysis", "categories": {}}
+    rep2 = compare(empty, empty)
+    assert rep2["op_categories"] is None
+
+
+def test_category_appearing_counts_as_shift():
+    base = _bench_with_categories(20.0, 10.0, 40.0)
+    new = _bench_with_categories(20.0, 10.0, 40.0)
+    new["op_breakdown"]["categories"]["fusion(unattributed)"] = {
+        "ms_per_step": 2.0, "pct": 6.0}
+    rep = compare(base, new)
+    regressed = {r["leg"] for r in rep["regressions"]}
+    assert "op_category:fusion(unattributed)" in regressed

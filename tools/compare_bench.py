@@ -16,6 +16,13 @@ Usage::
 
 Legs are extracted by dotted path; every metric is oriented so HIGHER is
 better (``step_ms``-style values are inverted at extraction).
+
+Beyond scalar legs, the op-breakdown *category* table
+(``op_breakdown.categories``) is diffed in percentage points of device
+time: an overhead category (``fusion(elementwise)``, ``data-movement``,
+...) growing its share by more than ``OP_CATEGORY_THRESHOLD_PP`` is
+flagged as a regression the same way a throughput leg is — the shape of
+the profile is an invariant ISSUE-9 paid for.
 """
 from __future__ import annotations
 
@@ -50,6 +57,52 @@ ABS_TOLERANCE = {
     "telemetry_overhead_pct": 1.0,  # percentage points (the <=1% claim)
     "resilience_overhead_pct": 1.0,  # ditto (docs/resilience.md)
 }
+
+# op-breakdown category diffing (ISSUE-9): a run whose *shape* of device
+# time shifted back toward the memory-bound buckets is a regression even
+# when throughput noise hides it. Only the overhead categories are gated
+# on GROWTH — shares sum to 100, so winning back elementwise time
+# necessarily grows the matmul/attention shares (that is the point, not
+# a regression).
+OP_CATEGORY_THRESHOLD_PP = 2.0  # percentage points of device time
+OVERHEAD_CATEGORIES = (
+    "fusion(elementwise)",
+    "fusion(unattributed)",
+    "data-movement",
+    "other",
+)
+
+
+def op_category_pcts(bench: Optional[dict]) -> Optional[Dict[str, float]]:
+    """``{category: pct-of-device-time}`` from a bench capture's
+    ``op_breakdown.categories`` table; None when the capture has no
+    breakdown (fast mode, pre-telemetry rounds)."""
+    ob = (bench or {}).get("op_breakdown")
+    cats = (ob or {}).get("categories") if isinstance(ob, dict) else None
+    if not isinstance(cats, dict):
+        return None
+    out: Dict[str, float] = {}
+    for name, entry in cats.items():
+        pct = entry.get("pct") if isinstance(entry, dict) else entry
+        if isinstance(pct, (int, float)) and not isinstance(pct, bool):
+            out[name] = float(pct)
+    return out or None
+
+
+def category_shift(base_pcts: Dict[str, float],
+                   new_pcts: Dict[str, float]) -> List[dict]:
+    """Per-category pct-point deltas, largest growth first. Categories
+    present on one side only count as 0 on the other (a category
+    appearing/disappearing IS a shift)."""
+    shifts = []
+    for cat in sorted(set(base_pcts) | set(new_pcts)):
+        b = base_pcts.get(cat, 0.0)
+        n = new_pcts.get(cat, 0.0)
+        shifts.append({"category": cat, "base_pct": round(b, 2),
+                       "new_pct": round(n, 2),
+                       "delta_pp": round(n - b, 2)})
+    shifts.sort(key=lambda s: -s["delta_pp"])
+    return shifts
 
 
 def _dig(d: dict, path: str):
@@ -146,6 +199,25 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
             improvements.append(entry)
         else:
             unchanged.append(leg)
+    # op-breakdown category shape: an overhead category (elementwise
+    # fusions, data movement) that grew its share of device time by more
+    # than the pp threshold regressed — flagged exactly like a
+    # throughput leg, because that is how the ISSUE-9 fused-tail wins
+    # erode (silently, behind stable tokens/sec on a different chip)
+    cat_report = None
+    bp, np_ = op_category_pcts(base), op_category_pcts(new)
+    if bp is not None and np_ is not None:
+        shifts = category_shift(bp, np_)
+        cat_report = {"threshold_pp": OP_CATEGORY_THRESHOLD_PP,
+                      "shift": shifts}
+        for s in shifts:
+            if (s["category"] in OVERHEAD_CATEGORIES
+                    and s["delta_pp"] > OP_CATEGORY_THRESHOLD_PP):
+                regressions.append({
+                    "leg": f"op_category:{s['category']}",
+                    "base": s["base_pct"], "new": s["new_pct"],
+                    "delta_pp": s["delta_pp"],
+                })
     # static-audit status alongside the perf legs: a capture whose
     # headline step STOPPED auditing clean is a regression even when
     # every throughput number held (the invariant broke, the cost shows
@@ -167,6 +239,7 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
         "only_in_base": sorted(set(a) - set(b)),
         "only_in_new": sorted(set(b) - set(a)),
         "audit": {"base": ab, "new": an},
+        "op_categories": cat_report,
     }
 
 

@@ -5,6 +5,10 @@ this tool self-hosts it on the steps the performance story depends on:
 
 - ``gpt_step``         the headline bench configuration in miniature
                        (bf16 GPT + packed FusedAdam, donated carry);
+- ``fused_block_step``  the PR-9 headline configuration: the same step
+                       with the fused transformer-block tail kernels
+                       (``ops/fused_block.py``) and the
+                       ``selective_elementwise`` remat policy;
 - ``packed_adam_step``  the packed FusedAdam sweep (flat fp32 state,
                        masters, in-place Pallas kernels);
 - ``packed_lamb_step``  the packed FusedLAMB two-stage step;
@@ -77,6 +81,47 @@ def build_gpt_step():
     return step, (params, opt_state, jnp.float32(0)), {}
 
 
+def build_fused_block_step():
+    """gpt_step with the fused-block tail kernels + selective_elementwise
+    remat — the PR-9 headline shape. The kernels run interpreted so the
+    REAL pallas calls (and their named scopes / dtype flow) are in the
+    traced jaxpr on a CPU host, not the XLA fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import (
+        GPTConfig, gpt_loss, init_gpt_params,
+    )
+
+    cfg = GPTConfig(
+        num_layers=2, num_attention_heads=4, hidden_size=128,
+        vocab_size=512, max_position_embeddings=128,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16, layer_unroll=-1,
+        fused_block=True, fused_block_interpret=True,
+        recompute_granularity="selective_elementwise",
+    )
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16),
+        init_gpt_params(cfg, jax.random.PRNGKey(0)))
+    opt = FusedAdam(lr=1e-4, master_weights=True, packed=True,
+                    packed_interpret=True)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 128), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def train_step(params, opt_state, loss_prev):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss(cfg, p, tokens, labels))(params)
+        params, opt_state = opt.step(grads, opt_state, params)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    return step, (params, opt_state, jnp.float32(0)), {}
+
+
 def _packed_opt_target(opt_cls, **opt_kw):
     import jax
     import jax.numpy as jnp
@@ -127,6 +172,7 @@ def build_telemetry_drain():
 
 TARGETS = {
     "gpt_step": build_gpt_step,
+    "fused_block_step": build_fused_block_step,
     "packed_adam_step": build_packed_adam_step,
     "packed_lamb_step": build_packed_lamb_step,
     "telemetry_drain": build_telemetry_drain,
