@@ -32,9 +32,11 @@ See ``docs/resilience.md`` for the end-to-end story.
 from .chaos import (  # noqa: F401
     ChaosError,
     ChaosMonkey,
+    ServingChaos,
     StallingSink,
     corrupt_checkpoint,
     poison_grads,
+    request_storm,
     send_preemption,
 )
 from .manager import (  # noqa: F401
@@ -72,6 +74,7 @@ __all__ = [
     "IndexedBatches", "ResumableIterator", "TrainState", "capture",
     "host_snapshot", "resume_or_init",
     "HangError", "HangWatchdog", "dump_all_stacks",
-    "ChaosError", "ChaosMonkey", "StallingSink", "corrupt_checkpoint",
-    "poison_grads", "send_preemption",
+    "ChaosError", "ChaosMonkey", "ServingChaos", "StallingSink",
+    "corrupt_checkpoint", "poison_grads", "request_storm",
+    "send_preemption",
 ]

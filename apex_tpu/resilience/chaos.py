@@ -21,9 +21,17 @@ subsystem defends:
 - **Stalls** — :class:`StallingSink` blocks inside a recorder callback
   (the shape of a wedged host callback / storage write) so the watchdog
   has something real to catch.
+- **Serving faults** — :class:`ServingChaos` reaches into the serving
+  engine's seams (``apex_tpu.serving``): in-jit logit poisoning of one
+  request (the fault-isolation quarantine proof), a wedged step sync
+  (the armed-watchdog proof), an engine kill mid-flight (the
+  restart-with-replay proof), stolen page allocations (spurious
+  preemption pressure), and :func:`request_storm` malformed-request
+  batches (every refusal path fires with a typed reason).
 
-Used by ``tests/test_resilience.py``, ``tests/test_crash_resume.py``
-and the CI smoke ``tools/resilience_check.py --self``.
+Used by ``tests/test_resilience.py``, ``tests/test_crash_resume.py``,
+``tests/test_serving_robustness.py`` and the CI smokes
+``tools/resilience_check.py --self`` / ``tools/serving_check.py --self``.
 """
 from __future__ import annotations
 
@@ -32,10 +40,11 @@ import pathlib
 import signal
 import threading
 import time
-from typing import Iterable, Optional, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Pytree = object
 
@@ -166,3 +175,141 @@ class StallingSink:
 def stall(seconds: float) -> None:
     """A plain host stall (for wrapping into callbacks under test)."""
     time.sleep(float(seconds))
+
+
+class ServingChaos:
+    """Fault injection for the serving engine's seams.
+
+    Pass an instance as ``ServingEngine(chaos=...)`` — the engine
+    forwards it to the scheduler for the allocation seam. Every fault
+    is armed once and fires once (``faults_fired`` records what landed),
+    so a recovered engine carrying the same injector does not re-die.
+
+    - :meth:`poison_request` — turn one request's logits non-finite
+      IN-JIT (via the step's poison mask) at a chosen engine step, or
+      at its first active step; the quarantine path must isolate it.
+    - :meth:`wedge_step_at` — stall the step's one host sync (the shape
+      of a hung device / wedged transfer); the armed
+      ``resilience.HangWatchdog`` must catch it with thread stacks.
+    - :meth:`kill_engine_at` — raise :class:`ChaosError` at a step
+      boundary (the engine process dying mid-flight); recovery must
+      replay the in-flight requests token-identically.
+    - :meth:`fail_allocs` — the next N page allocations report a dry
+      pool even when pages are free (a transient allocator fault),
+      driving the preemption machinery spuriously; invariants must
+      hold and every request still terminate.
+    """
+
+    def __init__(self):
+        self._poison: Dict[int, Optional[int]] = {}  # rid -> step|None
+        self._kill: Set[int] = set()
+        self._wedge: Dict[int, float] = {}
+        self._fail_alloc = 0
+        self.faults_fired: list = []
+
+    # -- poisoned logits ---------------------------------------------------
+    def poison_request(self, rid: int,
+                       at_step: Optional[int] = None) -> "ServingChaos":
+        """Arm a non-finite-logits fault for request ``rid`` — at engine
+        step ``at_step``, or (None) its first active step."""
+        self._poison[int(rid)] = None if at_step is None else int(at_step)
+        return self
+
+    def poison_mask(self, occupants: Sequence[Optional[int]],
+                    step: int) -> Optional[np.ndarray]:
+        """[n_slots] bool mask for this step (None = nothing fires).
+        ``occupants`` is the per-slot rid (None = empty)."""
+        if not self._poison:
+            return None
+        mask = np.zeros((len(occupants),), bool)
+        fired = False
+        for i, rid in enumerate(occupants):
+            if rid is None or rid not in self._poison:
+                continue
+            when = self._poison[rid]
+            if when is not None and when != int(step):
+                continue
+            mask[i] = True
+            fired = True
+            del self._poison[rid]
+            self.faults_fired.append(("poison", int(rid), int(step)))
+        return mask if fired else None
+
+    # -- engine kill -------------------------------------------------------
+    def kill_engine_at(self, *steps: int) -> "ServingChaos":
+        """Die (raise :class:`ChaosError`) at these step boundaries."""
+        self._kill.update(int(s) for s in steps)
+        return self
+
+    def maybe_kill(self, step: int) -> None:
+        if int(step) in self._kill:
+            self._kill.discard(int(step))
+            self.faults_fired.append(("kill", int(step)))
+            raise ChaosError(f"injected engine kill at step {step}")
+
+    # -- wedged step sync --------------------------------------------------
+    def wedge_step_at(self, step: int,
+                      stall_s: float = 30.0) -> "ServingChaos":
+        """The step's host sync at ``step`` blocks ``stall_s`` seconds
+        (bounded, so an un-watched run cannot hang forever)."""
+        self._wedge[int(step)] = float(stall_s)
+        return self
+
+    def maybe_wedge(self, step: int) -> None:
+        stall_s = self._wedge.pop(int(step), None)
+        if stall_s is not None:
+            self.faults_fired.append(("wedge", int(step)))
+            time.sleep(stall_s)
+
+    # -- allocator faults --------------------------------------------------
+    def fail_allocs(self, n: int) -> "ServingChaos":
+        """The next ``n`` page allocations look exhausted."""
+        self._fail_alloc += int(n)
+        return self
+
+    def steal_alloc(self) -> bool:
+        """Consulted by ``Scheduler.ensure_capacity`` per allocation."""
+        if self._fail_alloc > 0:
+            self._fail_alloc -= 1
+            self.faults_fired.append(("alloc", None))
+            return True
+        return False
+
+
+def request_storm(engine, seed: int = 0) -> List[tuple]:
+    """A batch of malformed/oversized serving requests built against a
+    live engine's actual limits, each paired with the
+    :class:`~apex_tpu.serving.RejectionCode` its refusal must carry —
+    the admission front door's fuzz fixture for
+    ``ServingEngine.try_submit``. Returns ``[(Request, RejectionCode),
+    ...]``; none of them may leave any scheduler/allocator state
+    behind."""
+    from ..serving import RejectionCode, Request  # lazy: no import cycle
+
+    rng = np.random.default_rng(seed)
+    vocab = engine.cfg.vocab_size
+    maxpos = engine.cfg.max_position_embeddings
+    spec = engine.spec
+
+    def toks(n):
+        return [int(t) for t in rng.integers(0, vocab, size=n)]
+
+    storm = [
+        (Request(prompt=[], max_new_tokens=4),
+         RejectionCode.EMPTY_PROMPT),
+        (Request(prompt=toks(engine.max_prompt_len + 1),
+                 max_new_tokens=1),
+         RejectionCode.PROMPT_TOO_LONG),
+        (Request(prompt=toks(1), max_new_tokens=0),
+         RejectionCode.BAD_MAX_NEW),
+        (Request(prompt=toks(1), max_new_tokens=maxpos),
+         RejectionCode.EXCEEDS_MAX_SEQ),
+    ]
+    # pool-infeasible (needs more pages than the whole pool) is only
+    # constructible when the pool is smaller than the sequence cap —
+    # exactly the tiny-pool engines the chaos tests run
+    need = (spec.n_usable_pages + 1) * spec.page_size
+    if need <= min(maxpos, spec.max_seq_len):
+        storm.append((Request(prompt=toks(1), max_new_tokens=need - 1),
+                      RejectionCode.POOL_INFEASIBLE))
+    return storm

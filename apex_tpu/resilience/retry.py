@@ -65,6 +65,13 @@ class RetryPolicy:
       * 2**k))`` — "full jitter", so a fleet of preempted workers does
       not re-stampede the storage service in lockstep. ``base_delay=0``
       disables sleeping (the historical bench behaviour).
+    - ``deadline``: overall wall-clock budget in seconds across ALL
+      attempts (None = attempt-count only, the historical behaviour).
+      When the elapsed time plus the next backoff would cross the
+      budget, the retry loop gives up and the last exception surfaces —
+      a request-level SLO must bound the *total* time burned retrying,
+      not just how many times it spun (serving request retry,
+      ``ServingEngine.generate(retry_failed=...)``).
     """
 
     attempts: int = 3
@@ -72,6 +79,7 @@ class RetryPolicy:
     message_filter: Optional[Callable[[BaseException], bool]] = None
     base_delay: float = 0.0
     max_delay: float = 30.0
+    deadline: Optional[float] = None
     rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def is_transient(self, e: BaseException) -> bool:
@@ -104,6 +112,7 @@ def retry_call(
     tag: str = "call",
     sink=None,
     sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
 ):
     """Run ``fn()`` under ``policy``; return its result.
 
@@ -112,8 +121,14 @@ def retry_call(
     ``.record(dict)`` or a bare callable; ``None`` logs to stderr only)
     and sleeps the policy's jittered backoff. The final attempt's
     exception — or any non-transient one — propagates unchanged.
+
+    ``policy.deadline`` bounds the whole loop in wall-clock seconds
+    (measured by ``clock``, injectable for tests): when elapsed time
+    plus the next backoff would cross it, a ``retry_deadline`` event is
+    emitted and the last exception surfaces as if attempts had run out.
     """
     record = as_record(sink)
+    t0 = clock()
     last: Optional[BaseException] = None
     for attempt in range(1, policy.attempts + 1):
         try:
@@ -123,6 +138,21 @@ def retry_call(
             if not policy.is_transient(e) or attempt == policy.attempts:
                 raise
             d = policy.delay(attempt)
+            if policy.deadline is not None:
+                elapsed = clock() - t0
+                if elapsed + d >= policy.deadline:
+                    print(
+                        f"{tag}: deadline {policy.deadline:.2f}s "
+                        f"exhausted after {attempt} attempt(s) "
+                        f"({elapsed:.2f}s elapsed)",
+                        file=sys.stderr,
+                    )
+                    if record is not None:
+                        record({"event": "retry_deadline", "tag": tag,
+                                "attempt": attempt,
+                                "deadline_s": policy.deadline,
+                                "elapsed_s": round(elapsed, 3)})
+                    raise
             print(
                 f"{tag}: transient {type(e).__name__}, retrying "
                 f"(attempt {attempt + 1}/{policy.attempts}"

@@ -69,14 +69,15 @@ def dump_all_stacks() -> str:
 
 class _Armed:
     __slots__ = ("what", "deadline", "timeout_s", "tripped", "dump",
-                 "interrupt_done")
+                 "interrupt_done", "context")
 
-    def __init__(self, what: str, timeout_s: float):
+    def __init__(self, what: str, timeout_s: float, context=None):
         self.what = what
         self.timeout_s = timeout_s
         self.deadline = time.monotonic() + timeout_s
         self.tripped = False
         self.dump = ""
+        self.context = context
         # set once the monitor has finished firing (interrupt delivered
         # or skipped) — armed()'s exit path synchronizes on it
         self.interrupt_done = threading.Event()
@@ -110,11 +111,12 @@ class HangWatchdog:
 
     # -- deterministic wait (poll loop we own) -----------------------------
     def wait(self, ready, what: str, *,
-             timeout_s: Optional[float] = None) -> None:
+             timeout_s: Optional[float] = None, context=None) -> None:
         """Block until ``ready`` — a ``threading.Event`` or a bool
         predicate — or raise :class:`HangError` with a stack dump at the
         deadline. Runs entirely in the calling thread; no interrupt
-        machinery involved."""
+        machinery involved. ``context`` (a small dict — e.g. the serving
+        step number) is merged into the hang event record."""
         timeout_s = self.timeout_s if timeout_s is None else float(timeout_s)
         deadline = time.monotonic() + timeout_s
         is_event = hasattr(ready, "wait") and hasattr(ready, "is_set")
@@ -128,18 +130,22 @@ class HangWatchdog:
                 time.sleep(self.poll_s)
             if time.monotonic() >= deadline:
                 stacks = dump_all_stacks()
-                self._fire(what, timeout_s, stacks, interrupt=False)
+                self._fire(what, timeout_s, stacks, interrupt=False,
+                           context=context)
                 raise HangError(what, timeout_s, stacks)
 
     # -- armed context (blocks we don't own) -------------------------------
     @contextmanager
-    def armed(self, what: str, *, timeout_s: Optional[float] = None):
+    def armed(self, what: str, *, timeout_s: Optional[float] = None,
+              context=None):
         """Arm a deadline around a blocking call. If the block does not
         exit in time, the monitor thread dumps stacks, emits the hang
         event and calls ``on_hang`` (default: interrupt the main thread,
-        which this context converts into :class:`HangError`)."""
+        which this context converts into :class:`HangError`).
+        ``context`` is merged into the hang event record — the
+        post-mortem's "where were we" (e.g. the serving step number)."""
         timeout_s = self.timeout_s if timeout_s is None else float(timeout_s)
-        entry = _Armed(what, timeout_s)
+        entry = _Armed(what, timeout_s, context=context)
         with self._lock:
             self._armed.append(entry)
             self._ensure_monitor()
@@ -219,20 +225,24 @@ class HangWatchdog:
                     with self._lock:
                         still_armed = entry in self._armed
                     self._fire(entry.what, entry.timeout_s, entry.dump,
-                               interrupt=still_armed)
+                               interrupt=still_armed,
+                               context=entry.context)
                 finally:
                     entry.interrupt_done.set()
 
     def _fire(self, what: str, timeout_s: float, stacks: str,
-              *, interrupt: bool) -> None:
+              *, interrupt: bool, context=None) -> None:
         self.trips += 1
         print(f"hang watchdog fired: {what!r} exceeded {timeout_s:.1f}s",
               file=sys.stderr)
         print(stacks, file=sys.stderr)
         if self._record is not None:
             try:
-                self._record({"event": "hang", "what": what,
-                              "timeout_s": timeout_s, "stacks": stacks})
+                rec = {"event": "hang", "what": what,
+                       "timeout_s": timeout_s, "stacks": stacks}
+                if context:
+                    rec.update(context)
+                self._record(rec)
             except Exception:
                 pass  # the sink must never mask the hang itself
         if interrupt:

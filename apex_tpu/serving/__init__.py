@@ -19,13 +19,22 @@ training stack's own machinery:
   fixed-shape step interleaving prefill and decode (each slot consumes
   one token per step), KV/slot/metrics state donated, sampled tokens
   fed back on device, telemetry through the PR-2 cond-gated drain, and
-  the PR-4 auditor as the invariant gate (``engine.audit()``).
+  the PR-4 auditor as the invariant gate (``engine.audit()``);
+- :mod:`~apex_tpu.serving.robustness` — serving under fire: the typed
+  request lifecycle (``RequestStatus``), per-request TTFT/latency
+  deadlines, one :class:`RejectionReason` taxonomy for every refusal,
+  watermark admission control + :class:`DegradationPolicy` shedding,
+  in-jit non-finite quarantine, and restart-with-replay recovery
+  (``ServingEngine.recover_from``) — chaos-proven by
+  ``resilience.ServingChaos``.
 
 ``tools/serving_check.py --self`` is the CI smoke; ``docs/serving.md``
 the design document; ``bench.py``'s ``serving_throughput`` /
-``prefill_decode_split`` legs the measurements.
+``prefill_decode_split`` / ``serving_overload`` legs the measurements.
 """
 from .engine import (  # noqa: F401
+    NO_TOKEN,
+    POISONED,
     ServingEngine,
     SlotState,
     default_page_size,
@@ -38,6 +47,20 @@ from .kv_cache import (  # noqa: F401
     page_table_row,
     write_token_kv,
 )
+from .robustness import (  # noqa: F401
+    AdmissionConfig,
+    AdmissionController,
+    DegradationPolicy,
+    RejectionCode,
+    RejectionError,
+    RejectionReason,
+    RequestStatus,
+    TERMINAL_STATES,
+    TransientRequestFailure,
+    VirtualClock,
+    is_terminal,
+    recover_requests,
+)
 from .scheduler import (  # noqa: F401
     Request,
     RunningSlot,
@@ -46,18 +69,32 @@ from .scheduler import (  # noqa: F401
 )
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "DegradationPolicy",
     "KVCacheState",
+    "NO_TOKEN",
+    "POISONED",
     "PageAllocator",
     "PagedKVSpec",
+    "RejectionCode",
+    "RejectionError",
+    "RejectionReason",
     "Request",
+    "RequestStatus",
     "RunningSlot",
     "Scheduler",
     "SchedulerError",
     "ServingEngine",
     "SlotState",
+    "TERMINAL_STATES",
+    "TransientRequestFailure",
+    "VirtualClock",
     "decode_tokens",
     "default_page_size",
+    "is_terminal",
     "page_table_row",
+    "recover_requests",
     "reference_decode",
     "write_token_kv",
 ]

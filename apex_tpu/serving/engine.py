@@ -20,7 +20,22 @@ PR-4 auditor enforces):
   ``analysis.assert_step_clean`` verify all of this on the traced step;
 - the single host read per step is the fetch of that step's emitted
   tokens, which the scheduler needs for EOS/finish decisions (and the
-  caller needs anyway — it IS the output).
+  caller needs anyway — it IS the output). A ``HangWatchdog`` can arm
+  that one sync (``watchdog=``), so a wedged device/step surfaces as a
+  ``HangError`` with all-thread stacks instead of a silent stall.
+
+Robustness (``serving.robustness`` — the serving twin of
+``apex_tpu.resilience``): every request ends in exactly one typed
+terminal state; per-request TTFT / total-latency deadlines are enforced
+at each scheduling boundary (an expired slot is evicted, its pages
+freed, the request finalized ``TIMED_OUT``); admission control bounds
+the queue with watermark backpressure and token-budget feasibility;
+the step carries an in-jit non-finite check on each slot's logits, so
+a poisoned request is quarantined alone (``FAILED`` with slot/step
+provenance) while every other request's tokens stay byte-identical;
+and a dead engine's in-flight requests are recovered onto a fresh one
+through the recompute-preemption replay path
+(:meth:`ServingEngine.recover_from`).
 
 Scheduling (admission, lazy page allocation, preemption, eviction) runs
 on the host between steps (``serving.scheduler``); its decisions reach
@@ -34,7 +49,8 @@ training stack's mixed-precision discipline with no master copies.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -45,9 +61,26 @@ from ..amp import cast_params_for_inference
 from ..ops.flash_decode import _kernel_ok, flash_decode_available
 from .decode_model import decode_tokens, reference_decode  # noqa: F401
 from .kv_cache import KVCacheState, PagedKVSpec
+from .robustness import (
+    AdmissionConfig,
+    AdmissionController,
+    DegradationPolicy,
+    RejectionCode,
+    RejectionError,
+    RejectionReason,
+    RequestStatus,
+    TransientRequestFailure,
+    is_terminal,
+    recover_requests,
+)
 from .scheduler import Request, Scheduler, SchedulerError
 
 Pytree = Any
+
+#: emitted-token sentinels (the one fetched vector carries tokens AND
+#: the per-slot fault flag, so fault isolation adds no second host sync)
+NO_TOKEN = -1
+POISONED = -2
 
 
 class SlotState(NamedTuple):
@@ -99,7 +132,23 @@ class ServingEngine:
         sink=None,
         use_kernel: Optional[bool] = None,
         interpret: bool = False,
+        admission: Optional[AdmissionConfig] = None,
+        degradation: Optional[DegradationPolicy] = None,
+        watchdog=None,
+        step_timeout_s: Optional[float] = None,
+        chaos=None,
+        clock: Optional[Callable[[], float]] = None,
     ):
+        # recovery (recover_from) rebuilds an engine with the same
+        # geometry/policies; capture the kwargs before unpacking
+        self._ctor_kw = dict(
+            n_slots=n_slots, page_size=page_size, num_pages=num_pages,
+            pages_per_seq=pages_per_seq, max_prompt_len=max_prompt_len,
+            kv_dtype=kv_dtype, telemetry_every=telemetry_every,
+            record_every=record_every, sink=sink, use_kernel=use_kernel,
+            interpret=interpret, admission=admission,
+            degradation=degradation, watchdog=watchdog,
+            step_timeout_s=step_timeout_s, chaos=chaos, clock=clock)
         self.cfg = cfg
         n, d = cfg.num_attention_heads, cfg.kv_channels
         ps = page_size or default_page_size(n, d)
@@ -134,14 +183,28 @@ class ServingEngine:
                 f"head_dim={d} (needs page_size % 8 == 0 and head_dim "
                 "<= 256); pass use_kernel=False for the XLA fallback "
                 "or pick a compatible page_size")
+        self._chaos = chaos
         self.scheduler = Scheduler(self.spec, self.n_slots,
-                                   max_prompt_len=self._buf_len)
+                                   max_prompt_len=self._buf_len,
+                                   chaos=chaos)
+        self.admission = (
+            AdmissionController(admission, self.n_slots,
+                                degradation=degradation)
+            if admission is not None else None)
+        if degradation is not None and admission is None:
+            raise ValueError(
+                "degradation= requires admission= (the DegradationPolicy "
+                "acts through the AdmissionController's pressure state)")
+        self.watchdog = watchdog
+        self._step_timeout_s = step_timeout_s
+        self._clock = clock if clock is not None else time.perf_counter
         self.kv = self.spec.init_cache()
         self.slots = self._init_slots()
         self.metrics = telemetry.init_metrics()
         self._step = self._build_step()
         self._mutate = jax.jit(_mutate_slots, donate_argnums=(0,))
         self._occupants: List[Optional[int]] = [None] * self.n_slots
+        self._no_poison = jnp.zeros((self.n_slots,), bool)
         self.steps_run = 0
         self.last_stats: Dict[str, Any] = {}
         self._accum = self._fresh_accum()
@@ -152,7 +215,7 @@ class ServingEngine:
             "steps": 0, "active_slot_steps": 0, "prefill_slot_steps": 0,
             "decode_slot_steps": 0, "step_time_s": 0.0,
             "prefill_step_time_s": 0.0, "decode_step_time_s": 0.0,
-            "step_times_ms": [],
+            "step_times_ms": [], "max_queue_depth": 0,
         }
 
     # -- construction ------------------------------------------------------
@@ -172,11 +235,22 @@ class ServingEngine:
         use_kernel, interpret = self._use_kernel, self._interpret
         tel_every, sink = self.telemetry_every, self.sink
 
-        def step(params, kv, slots, page_tables, metrics):
+        def step(params, kv, slots, page_tables, poison, metrics):
             logits, kv = decode_tokens(
                 cfg, params, spec, kv, slots.tokens, slots.positions,
                 slots.active, page_tables,
                 use_kernel=use_kernel, interpret=interpret)
+            # chaos seam: the poison mask turns a slot's logits
+            # non-finite IN-JIT (the shape of a corrupted activation /
+            # poisoned weight shard) — one compiled program serves the
+            # armed and unarmed arms, like resilience.poison_grads
+            logits = jnp.where(poison[:, None], jnp.float32(jnp.nan),
+                               logits)
+            # fault isolation: per-slot non-finite check on the SAME
+            # logits read the argmax consumes. `bad` rides the emitted
+            # vector as the POISONED sentinel, so quarantine costs no
+            # extra host sync.
+            bad = slots.active & ~jnp.all(jnp.isfinite(logits), axis=-1)
             sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             next_pos = slots.positions + 1
             still_prefill = next_pos < slots.prompt_lens
@@ -186,7 +260,8 @@ class ServingEngine:
             # a slot that just consumed its LAST prompt token emits its
             # first generated token; decode slots emit every step
             emitted = jnp.where(slots.active & ~still_prefill,
-                                sampled, jnp.int32(-1))
+                                sampled, jnp.int32(NO_TOKEN))
+            emitted = jnp.where(bad, jnp.int32(POISONED), emitted)
             next_tok = jnp.where(still_prefill, prompt_next, sampled)
             slots = SlotState(
                 tokens=jnp.where(slots.active, next_tok, slots.tokens),
@@ -204,7 +279,7 @@ class ServingEngine:
                     metrics, sink, every_n=tel_every, tag="serving")
             return kv, slots, emitted, metrics
 
-        return jax.jit(step, donate_argnums=(1, 2, 4))
+        return jax.jit(step, donate_argnums=(1, 2, 5))
 
     # -- audit surface -----------------------------------------------------
     def step_program(self):
@@ -213,7 +288,8 @@ class ServingEngine:
         state, cond-gated callbacks only."""
         B, mp = self.n_slots, self.spec.pages_per_seq
         args = (self.params, self.spec.init_cache(), self._init_slots(),
-                jnp.zeros((B, mp), jnp.int32), telemetry.init_metrics())
+                jnp.zeros((B, mp), jnp.int32), jnp.zeros((B,), bool),
+                telemetry.init_metrics())
         return self._step, args
 
     def audit(self, **kw):
@@ -227,21 +303,200 @@ class ServingEngine:
         return assert_step_clean(fn, *args, **kw)
 
     # -- request intake ----------------------------------------------------
-    def submit(self, req: Request) -> None:
+    def _engine_reject_reason(self, req: Request
+                              ) -> Optional[RejectionReason]:
         if len(req.prompt) > self.max_prompt_len:
-            raise SchedulerError(
+            return RejectionReason(
+                RejectionCode.PROMPT_TOO_LONG,
                 f"request {req.rid}: prompt {len(req.prompt)} exceeds "
                 f"max_prompt_len {self.max_prompt_len}")
         total = len(req.prompt) + req.max_new_tokens
         if total > self.cfg.max_position_embeddings:
-            raise SchedulerError(
+            return RejectionReason(
+                RejectionCode.EXCEEDS_MAX_SEQ,
                 f"request {req.rid}: prompt+max_new = {total} exceeds "
                 f"max_position_embeddings "
                 f"{self.cfg.max_position_embeddings}")
         if req.max_new_tokens < 1:
-            raise SchedulerError(f"request {req.rid}: max_new_tokens < 1")
-        req.t_arrival = time.perf_counter()
-        self.scheduler.submit(req)
+            return RejectionReason(
+                RejectionCode.BAD_MAX_NEW,
+                f"request {req.rid}: max_new_tokens < 1")
+        return None
+
+    def try_submit(self, req: Request) -> Optional[RejectionReason]:
+        """Admit a request, or refuse it with a typed reason (finalized
+        ``REJECTED`` + ``reject`` telemetry) — the non-raising door
+        ``generate()`` and overload callers use.
+
+        Resubmitting a terminal request (after a rejection, or a
+        recovered ``FAILED``) starts a fresh lifecycle attempt;
+        ``t_arrival`` is stamped only once, so deadline budgets span
+        resubmits and restarts — the user has been waiting the whole
+        time, and the SLO accounting must say so.
+        """
+        if req.status in (RequestStatus.QUEUED, RequestStatus.RUNNING):
+            # a duplicate submission of in-flight work would put ONE
+            # Request object in two queue positions / slots (shared
+            # out_tokens, double finalize); refuse WITHOUT finalizing —
+            # the live submission keeps running
+            reason = RejectionReason(
+                RejectionCode.ALREADY_IN_FLIGHT,
+                f"request {req.rid}: already in flight "
+                f"({req.status.value})")
+            self.sink.record({"event": "reject", "rid": req.rid,
+                              **reason.as_record()})
+            return reason
+        if is_terminal(req.status):
+            req.status = RequestStatus.PENDING
+            req.end_reason = None
+        now = self._clock()
+        if req.t_arrival is None:
+            req.t_arrival = now
+        ctl = self.admission
+        depth = len(self.scheduler.waiting)
+        reason = self._engine_reject_reason(req)
+        if reason is None:
+            reason = self.scheduler.validate(req)
+        if reason is None and ctl is not None:
+            queued_tokens = self._queued_tokens()
+            reason = ctl.check(req, queue_depth=depth,
+                               queued_tokens=queued_tokens)
+        if reason is not None:
+            self.sink.record({"event": "reject", "rid": req.rid,
+                              "queue_depth": depth,
+                              **reason.as_record()})
+            self._finalize(req, RequestStatus.REJECTED,
+                           reason.code.value, now=now)
+            return reason
+        if ctl is not None:
+            # graceful degradation, applied only to work that is
+            # actually being admitted: less work per request keeps the
+            # door open under pressure, and the cut is recorded against
+            # the run that honors it (a rejected request keeps its
+            # requested max_new for any later resubmit)
+            cap = ctl.cap_for(req, depth)
+            if cap is not None:
+                self.sink.record({
+                    "event": "degrade", "rid": req.rid,
+                    "max_new_tokens": cap,
+                    "requested_max_new": req.max_new_tokens})
+                req.max_new_tokens = cap
+        req.status = RequestStatus.QUEUED
+        self.scheduler.waiting.append(req)
+        return None
+
+    def submit(self, req: Request) -> None:
+        """The raising intake (historical API): refusal raises
+        :class:`~.robustness.RejectionError` (a ``SchedulerError``)
+        carrying the typed reason."""
+        reason = self.try_submit(req)
+        if reason is not None:
+            raise RejectionError(reason)
+
+    def cancel(self, req: Request) -> bool:
+        """Withdraw a request: removed from the queue or evicted from
+        its slot (pages freed), finalized ``CANCELLED``. Returns False
+        when it is not in flight (already terminal / unknown)."""
+        sched = self.scheduler
+        now = self._clock()
+        if sched.remove_waiting(req):
+            self._finalize(req, RequestStatus.CANCELLED, "cancelled",
+                           now=now)
+            return True
+        for i, run in sched.running():
+            if run.req is req:
+                sched.evict(i)
+                self._finalize(req, RequestStatus.CANCELLED, "cancelled",
+                               now=now)
+                return True
+        return False
+
+    def _queued_tokens(self) -> int:
+        """Token-budget view of the waiting queue: tokens still to be
+        consumed (replay prompt + remaining generation)."""
+        return sum(
+            len(r.prompt) + r.max_new_tokens  # out_tokens replay nets out
+            for r in self.scheduler.waiting)
+
+    # -- lifecycle ---------------------------------------------------------
+    def _finalize(self, req: Request, status: RequestStatus, reason: str,
+                  *, now: float, failure: Optional[dict] = None) -> None:
+        """One typed terminal state per request + a structured
+        ``request_end`` record through the PR-2 recorder."""
+        if is_terminal(req.status):  # explicit: must survive python -O
+            raise AssertionError(
+                f"request {req.rid} finalized twice "
+                f"({req.status.name} -> {status.name})")
+        req.status = status
+        req.end_reason = reason
+        if failure is not None:
+            req.failure = dict(failure)
+        if req.t_done is None and status is RequestStatus.COMPLETED:
+            req.t_done = now
+        rec = {
+            "event": "request_end", "rid": req.rid,
+            "status": status.value, "reason": reason,
+            "generated": len(req.out_tokens),
+            "preemptions": req.preemptions,
+            "restarts": req.restarts,
+        }
+        if failure is not None:
+            rec["failure"] = dict(failure)
+        self.sink.record(rec)
+
+    def _enforce_deadlines(self, now: float) -> None:
+        """Evict expired work at the scheduling boundary: a request past
+        its total-latency budget — or still waiting on its first token
+        past its TTFT budget — is finalized ``TIMED_OUT``, its slot
+        freed and pages returned, instead of silently occupying
+        capacity."""
+        sched = self.scheduler
+
+        def expired(req: Request) -> Optional[str]:
+            if req.t_arrival is None:
+                return None
+            age_ms = (now - req.t_arrival) * 1e3
+            if (req.latency_budget_ms is not None
+                    and age_ms > req.latency_budget_ms):
+                return "latency_budget"
+            if (req.ttft_budget_ms is not None
+                    and req.t_first_token is None
+                    and age_ms > req.ttft_budget_ms):
+                return "ttft_budget"
+            return None
+
+        for req in list(sched.waiting):
+            why = expired(req)
+            if why is not None:
+                sched.remove_waiting(req)
+                self._finalize(req, RequestStatus.TIMED_OUT, why, now=now)
+        for i, run in list(sched.running()):
+            why = expired(run.req)
+            if why is not None:
+                sched.evict(i)
+                self._finalize(run.req, RequestStatus.TIMED_OUT, why,
+                               now=now)
+
+    def _boundary_degradation(self, now: float) -> None:
+        """Sustained pressure sheds queued work: deadline-infeasible
+        first, then lowest-priority-youngest, until the queue drains to
+        the low watermark."""
+        ctl = self.admission
+        sched = self.scheduler
+        if not ctl.note_boundary(len(sched.waiting)):
+            return
+        while len(sched.waiting) > ctl.low_count:
+            victim = ctl.pick_shed_victim(sched.waiting,
+                                          self._queued_tokens())
+            if victim is None:
+                break
+            sched.remove_waiting(victim)
+            ctl.shed += 1
+            self.sink.record({"event": "shed", "rid": victim.rid,
+                              "priority": victim.priority,
+                              "queue_depth": len(sched.waiting)})
+            self._finalize(victim, RequestStatus.REJECTED, "shed",
+                           now=now)
 
     # -- the loop ----------------------------------------------------------
     def _sync_device_slots(self) -> None:
@@ -279,14 +534,52 @@ class ServingEngine:
             prompt_lens=jnp.asarray(prompt_lens))
         self.slots = self._mutate(self.slots, jnp.asarray(mask), new)
 
+    def _poison_mask(self, step_no: int):
+        """The chaos poison-injection mask for this step ([B] bool on
+        device; the cached all-False buffer when nothing fires)."""
+        if self._chaos is None:
+            return self._no_poison
+        occupants = [None if s is None else s.req.rid
+                     for s in self.scheduler.slots]
+        mask = self._chaos.poison_mask(occupants, step_no)
+        if mask is None:
+            return self._no_poison
+        return jnp.asarray(mask)
+
+    def _fetch_emitted(self, emitted, step_no: int) -> np.ndarray:
+        """The step's one host sync, optionally under an armed
+        watchdog deadline (a wedged sync raises ``HangError`` with
+        all-thread stacks + a ``hang`` event instead of stalling the
+        engine forever). The chaos wedge fires inside the armed window
+        — that is the fault the watchdog exists to catch."""
+        def fetch():
+            if self._chaos is not None:
+                self._chaos.maybe_wedge(step_no)
+            return np.asarray(emitted)
+
+        if self.watchdog is None:
+            return fetch()
+        with self.watchdog.armed("serving_step_host_sync",
+                                 timeout_s=self._step_timeout_s,
+                                 context={"step": step_no}):
+            return fetch()
+
     def run_step(self) -> np.ndarray:
         """One scheduling boundary + one device step; returns the
-        emitted-token vector ([B], -1 = no token)."""
+        emitted-token vector ([B], -1 = no token, -2 = quarantined)."""
         sched = self.scheduler
+        step_no = self.steps_run
+        if self._chaos is not None:
+            self._chaos.maybe_kill(step_no)  # raises ChaosError
+        boundary_t = now = self._clock()
+        self._enforce_deadlines(now)
+        if self.admission is not None:
+            self._boundary_degradation(now)
         sched.admit()
         sched.ensure_capacity()
         self._sync_device_slots()
         page_tables = jnp.asarray(sched.page_table_array())
+        poison = self._poison_mask(step_no)
         # host classification BEFORE the step (deterministic mirrors):
         # which slots consume prompt vs generated tokens this step
         served = sched.running()
@@ -294,22 +587,45 @@ class ServingEngine:
         decode_slots = [i for i, r in served if not r.prefilling]
         t0 = time.perf_counter()
         self.kv, self.slots, emitted, self.metrics = self._step(
-            self.params, self.kv, self.slots, page_tables, self.metrics)
-        em = np.asarray(emitted)  # the one host sync per step
+            self.params, self.kv, self.slots, page_tables, poison,
+            self.metrics)
+        em = self._fetch_emitted(emitted, step_no)  # the one host sync
         dt = time.perf_counter() - t0
-        now = time.perf_counter()
+        now = self._clock()
+        if self.admission is not None:
+            # feed the EWMA in the SAME clock the deadline budgets are
+            # denominated in (boundary-to-boundary), so token-budget
+            # feasibility stays meaningful under an injected clock;
+            # bench timing (_acct) stays on perf_counter
+            self.admission.observe_step(now - boundary_t)
         sched.advance([i for i, _ in served])
         for i, run in served:
             tok = int(em[i])
+            req = run.req
+            if tok == POISONED:
+                # fault isolation: quarantine ONLY this slot — evict,
+                # free its pages, finalize FAILED with provenance; the
+                # other slots' rows never mixed with its math, so their
+                # tokens are byte-identical to an undisturbed run
+                sched.evict(i)
+                self._finalize(
+                    req, RequestStatus.FAILED, "nonfinite_logits",
+                    now=now,
+                    failure={"kind": "nonfinite_logits", "slot": i,
+                             "step": step_no, "rid": req.rid,
+                             "position": run.pos,
+                             "transient": True})
+                continue
             if tok < 0:
                 continue
-            req = run.req
             if req.t_first_token is None:
                 req.t_first_token = now
             req.out_tokens.append(tok)
             if req.done:
                 req.t_done = now
                 sched.evict(i)
+                self._finalize(req, RequestStatus.COMPLETED, "done",
+                               now=now)
         self.steps_run += 1
         self._acct(len(served), len(prefill_slots), len(decode_slots), dt)
         return em
@@ -321,6 +637,8 @@ class ServingEngine:
         a["prefill_slot_steps"] += n_prefill
         a["decode_slot_steps"] += n_decode
         a["step_time_s"] += dt
+        a["max_queue_depth"] = max(a["max_queue_depth"],
+                                   len(self.scheduler.waiting))
         # mixed steps pro-rate wall time by slot counts (matching the
         # slot-step accounting above) — under continuous batching most
         # steps serve both phases at once
@@ -335,29 +653,19 @@ class ServingEngine:
                 "active": n_active,
                 "occupancy": n_active / self.n_slots,
                 "free_pages": self.scheduler.allocator.free_count,
+                "queue_depth": len(self.scheduler.waiting),
             })
 
-    def generate(self, requests: Sequence[Request],
-                 max_steps: Optional[int] = None) -> Dict[int, List[int]]:
-        """Run a request trace to completion under continuous batching.
-
-        Requests with ``arrival_step > 0`` are held back and submitted
-        at that step boundary — the staggered-admission traces the
-        token-identity acceptance runs. Returns ``{rid: tokens}`` and
-        fills :attr:`last_stats` (latency percentiles via
-        ``telemetry.percentiles``, throughput, occupancy, the
-        prefill/decode split).
-        """
-        self._accum = self._fresh_accum()
-        pending = sorted(requests, key=lambda r: (r.arrival_step, r.rid))
-        all_reqs = list(pending)
-        t_start = time.perf_counter()
-        step_i = 0
+    def _drain(self, pending: List[Request], start_step: int,
+               max_steps: Optional[int]) -> int:
+        """Submit arrivals and run steps until the trace drains; the
+        shared loop under ``generate()`` and its retry passes."""
+        step_i = start_step
         while True:
             while pending and pending[0].arrival_step <= step_i:
-                self.submit(pending.pop(0))
+                self.try_submit(pending.pop(0))
             if not pending and self.scheduler.idle:
-                break
+                return step_i
             if max_steps is not None and step_i >= max_steps:
                 raise SchedulerError(
                     f"generate exceeded max_steps={max_steps} with "
@@ -368,34 +676,152 @@ class ServingEngine:
                 continue
             self.run_step()
             step_i += 1
+
+    def generate(self, requests: Sequence[Request],
+                 max_steps: Optional[int] = None,
+                 retry_failed=None) -> Dict[int, List[int]]:
+        """Run a request trace to completion under continuous batching.
+
+        Requests with ``arrival_step > 0`` are held back and submitted
+        at that step boundary — the staggered-admission traces the
+        token-identity acceptance runs. Rejected requests (admission
+        control, legacy refusals) are finalized ``REJECTED`` and the
+        trace continues. Returns ``{rid: tokens}`` and fills
+        :attr:`last_stats` (latency percentiles over COMPLETED requests
+        via ``telemetry.percentiles``, throughput, occupancy, the
+        terminal-state buckets, the prefill/decode split).
+
+        ``retry_failed``: a :class:`~apex_tpu.resilience.RetryPolicy`
+        for request-level retry of transient ``FAILED`` requests (e.g.
+        a quarantined non-finite burst): each retry pass resubmits them
+        through the recompute replay path (generated tokens are kept),
+        under the policy's attempt count and wall-clock ``deadline``
+        budget (its ``retry_on`` filter is ignored here — the trigger
+        is always the internal retry signal); requests still failing
+        when the policy exhausts stay ``FAILED``.
+        """
+        self._accum = self._fresh_accum()
+        pending = sorted(requests, key=lambda r: (r.arrival_step, r.rid))
+        all_reqs = list(pending)
+        t_start = time.perf_counter()
+        step_i = self._drain(pending, 0, max_steps)
+        if retry_failed is not None:
+            self._retry_failed(all_reqs, step_i, max_steps, retry_failed)
         wall = time.perf_counter() - t_start
         self.last_stats = self._summarize(all_reqs, wall)
         self.sink.record({"event": "serving_summary", **self.last_stats})
         return {r.rid: list(r.out_tokens) for r in all_reqs}
 
+    def _retry_failed(self, all_reqs, step_i, max_steps, policy) -> None:
+        """Request-level retry of FAILED-transient requests under a
+        ``RetryPolicy``. Only the policy's pacing knobs (attempts,
+        backoff, wall-clock ``deadline``) apply — the trigger is always
+        :class:`TransientRequestFailure`, so callers need not (and must
+        not) tune ``retry_on`` for this internal loop. A retry pass
+        that blows the step budget (``max_steps``) is abandoned: the
+        stranded requests are finalized ``FAILED`` instead of escaping
+        ``generate()`` mid-lifecycle."""
+        import dataclasses as _dc
+
+        from ..resilience.retry import retry_call
+
+        def transient_failed():
+            return [r for r in all_reqs
+                    if r.status is RequestStatus.FAILED
+                    and (r.failure or {}).get("transient")]
+
+        if not transient_failed():
+            return
+
+        def attempt():
+            retryable = transient_failed()
+            for r in retryable:
+                r.status = RequestStatus.PENDING
+                r.end_reason = None
+                r.retries += 1
+                r.arrival_step = 0
+            self._drain(list(retryable), step_i, max_steps)
+            still = transient_failed()
+            if still:
+                raise TransientRequestFailure(still)
+
+        eff = _dc.replace(policy, retry_on=(TransientRequestFailure,),
+                          message_filter=None)
+        try:
+            retry_call(attempt, policy=eff, tag="serving request retry",
+                       sink=self.sink)
+        except TransientRequestFailure:
+            pass  # policy exhausted: they stay FAILED, summary shows it
+        except SchedulerError as e:
+            now = self._clock()
+            for r in all_reqs:
+                if not is_terminal(r.status):
+                    self._abort_in_flight(r, now)
+            self.sink.record({"event": "retry_abandoned",
+                              "error": str(e)})
+
+    def _abort_in_flight(self, req: Request, now: float,
+                         reason: str = "retry_abandoned") -> None:
+        """Pull a non-terminal request out of the queue/its slot (pages
+        freed) and finalize it FAILED — the abandonment path when a
+        retry pass cannot continue."""
+        sched = self.scheduler
+        if not sched.remove_waiting(req):
+            for i, run in sched.running():
+                if run.req is req:
+                    sched.evict(i)
+                    break
+        self._finalize(req, RequestStatus.FAILED, reason, now=now)
+
     def _summarize(self, reqs, wall_s) -> Dict[str, Any]:
         a = self._accum
+        # bucket by terminal state: percentiles below are computed over
+        # COMPLETED requests only — a timed-out or failed request's
+        # stamps must not contaminate the latency distribution
+        completed = [r for r in reqs
+                     if r.status is RequestStatus.COMPLETED]
+        by_status = {
+            s.value: sum(r.status is s for r in reqs)
+            for s in (RequestStatus.COMPLETED, RequestStatus.REJECTED,
+                      RequestStatus.TIMED_OUT, RequestStatus.FAILED,
+                      RequestStatus.CANCELLED)}
         total_tokens = sum(len(r.out_tokens) for r in reqs)
-        lat_ms = [(r.t_done - r.t_arrival) * 1e3 for r in reqs
+        lat_ms = [(r.t_done - r.t_arrival) * 1e3 for r in completed
                   if r.t_done is not None and r.t_arrival is not None]
-        ttft_ms = [(r.t_first_token - r.t_arrival) * 1e3 for r in reqs
+        ttft_ms = [(r.t_first_token - r.t_arrival) * 1e3
+                   for r in completed
                    if r.t_first_token is not None
                    and r.t_arrival is not None]
         slot_steps = a["active_slot_steps"]
+        slo = [r for r in completed if self._within_budget(r)]
+        goodput_tokens = sum(len(r.out_tokens) for r in slo)
         return {
             "n_requests": len(reqs),
-            "completed": sum(r.done for r in reqs),
+            "completed": len(completed),
+            "by_status": by_status,
             "preemptions": sum(r.preemptions for r in reqs),
+            "retries": sum(r.retries for r in reqs),
             "steps": a["steps"],
             "wall_s": round(wall_s, 4),
             "generated_tokens": total_tokens,
             "tokens_per_sec": round(total_tokens / wall_s, 2)
+            if wall_s > 0 else None,
+            # SLO view: requests that completed within their own
+            # budgets (no-budget requests count as attained), over ALL
+            # submitted requests — rejected/shed/timed-out work counts
+            # against attainment, that is the point of measuring it
+            "slo_attained": len(slo),
+            "slo_attainment": round(len(slo) / len(reqs), 4)
+            if reqs else None,
+            "goodput_tokens": goodput_tokens,
+            "goodput_tokens_per_sec": round(goodput_tokens / wall_s, 2)
             if wall_s > 0 else None,
             # mean batch occupancy — the serving analogue of the
             # pipeline bubble fraction: idle slot-steps are the bubble
             "occupancy": round(
                 slot_steps / (a["steps"] * self.n_slots), 4)
             if a["steps"] else None,
+            "max_queue_depth": a["max_queue_depth"],
             "latency_ms": telemetry.percentiles(lat_ms),
             "ttft_ms": telemetry.percentiles(ttft_ms),
             "step_ms": telemetry.percentiles(a["step_times_ms"]),
@@ -404,6 +830,48 @@ class ServingEngine:
             "prefill_step_time_s": round(a["prefill_step_time_s"], 4),
             "decode_step_time_s": round(a["decode_step_time_s"], 4),
         }
+
+    @staticmethod
+    def _within_budget(req: Request) -> bool:
+        if req.t_arrival is None:
+            return True
+        if (req.latency_budget_ms is not None and req.t_done is not None
+                and (req.t_done - req.t_arrival) * 1e3
+                > req.latency_budget_ms):
+            return False
+        if (req.ttft_budget_ms is not None
+                and req.t_first_token is not None
+                and (req.t_first_token - req.t_arrival) * 1e3
+                > req.ttft_budget_ms):
+            return False
+        return True
+
+    # -- recovery ----------------------------------------------------------
+    @classmethod
+    def recover_from(cls, dead: "ServingEngine", **overrides
+                     ) -> Tuple["ServingEngine", List[Request]]:
+        """Restart-with-replay: build a fresh engine with the dead
+        engine's config/weights/policies and pull its non-terminal
+        requests out for re-submission — in-flight work rides the
+        existing recompute-preemption replay path (generated tokens
+        fold into the replay prompt), so survivors complete
+        token-identically to an uninterrupted run.
+
+        Returns ``(engine, survivors)``; drive them with
+        ``engine.generate(survivors)``. ``overrides`` patch ctor kwargs
+        (e.g. ``chaos=None`` to disarm a fault injector).
+        """
+        kw = dict(dead._ctor_kw)
+        kw.update(overrides)
+        survivors = recover_requests(dead)
+        eng = cls(dead.cfg, dead.params, **kw)
+        eng.sink.record({
+            "event": "engine_recovery",
+            "recovered": len(survivors),
+            "rids": [r.rid for r in survivors],
+            "dead_steps_run": dead.steps_run,
+        })
+        return eng, survivors
 
 
 def _mutate_slots(slots: SlotState, mask: jax.Array,

@@ -32,6 +32,13 @@ from typing import Deque, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .kv_cache import PageAllocator, PagedKVSpec, page_table_row
+from .robustness import (
+    RejectionCode,
+    RejectionError,
+    RejectionReason,
+    RequestStatus,
+    SchedulerError,  # noqa: F401  (re-export: historical home)
+)
 
 _rid_counter = itertools.count()
 
@@ -42,13 +49,21 @@ class Request:
 
     ``arrival_step`` lets traces stagger admissions deterministically
     (the continuous-batching tests and the bench leg submit a whole
-    trace up front).
+    trace up front). ``ttft_budget_ms``/``latency_budget_ms`` are
+    wall-clock deadlines against the engine's clock (None = no
+    deadline); ``priority`` orders shed-victim selection under
+    degradation (higher = keep longer). ``status`` walks the
+    :class:`~.robustness.RequestStatus` lifecycle and lands in exactly
+    one terminal state.
     """
 
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
     arrival_step: int = 0
+    priority: int = 0
+    ttft_budget_ms: Optional[float] = None
+    latency_budget_ms: Optional[float] = None
     rid: int = dataclasses.field(
         default_factory=lambda: next(_rid_counter))
     # engine-filled results / timestamps
@@ -57,6 +72,12 @@ class Request:
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
     preemptions: int = 0
+    # lifecycle (serving.robustness): terminal state + why + provenance
+    status: RequestStatus = RequestStatus.PENDING
+    end_reason: Optional[str] = None
+    failure: Optional[dict] = None
+    retries: int = 0
+    restarts: int = 0
     # seniority, assigned at FIRST admission and stable across
     # preemptions — the total order that makes preemption terminate
     # (younger never preempts older, so the most senior request always
@@ -92,10 +113,6 @@ class RunningSlot:
         return len(self.prompt) + remaining
 
 
-class SchedulerError(RuntimeError):
-    pass
-
-
 class Scheduler:
     """Continuous batching over ``n_slots`` fixed slots.
 
@@ -104,10 +121,15 @@ class Scheduler:
     pages, preempting if the pool is dry), :meth:`page_table_array`,
     then — after the device step — :meth:`advance` and, for finished
     requests, :meth:`evict`.
+
+    ``chaos`` (optional, duck-typed — ``resilience.ServingChaos``) lets
+    the fault harness steal page allocations: a stolen ``alloc`` looks
+    exactly like a dry pool, driving the preemption machinery under
+    test without actually shrinking it.
     """
 
     def __init__(self, spec: PagedKVSpec, n_slots: int,
-                 max_prompt_len: int):
+                 max_prompt_len: int, chaos=None):
         self.spec = spec
         self.n_slots = int(n_slots)
         self.max_prompt_len = int(max_prompt_len)
@@ -115,19 +137,47 @@ class Scheduler:
         self.slots: List[Optional[RunningSlot]] = [None] * self.n_slots
         self.waiting: Deque[Request] = deque()
         self._admit_seq = itertools.count()
+        self.chaos = chaos
 
     # -- queue -------------------------------------------------------------
     def submit(self, req: Request) -> None:
-        self._validate(req, len(req.prompt))
+        reason = self.validate(req)
+        if reason is not None:
+            raise RejectionError(reason)
+        req.status = RequestStatus.QUEUED
         self.waiting.append(req)
 
-    def _validate(self, req: Request, prompt_len: int) -> None:
+    def remove_waiting(self, req: Request) -> bool:
+        """Pull a queued request back out (timeout, shed, cancel). The
+        caller finalizes its status; pages were never allocated for a
+        waiting request, so there is nothing else to release."""
+        try:
+            self.waiting.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def validate(self, req: Request,
+                 prompt_len: Optional[int] = None
+                 ) -> Optional[RejectionReason]:
+        """The PR-6 refusal paths, now returning a typed
+        :class:`~.robustness.RejectionReason` (``None`` = admissible)
+        so admission control and the legacy refusals share one
+        taxonomy. :meth:`submit` raises :class:`RejectionError` —
+        still a :class:`SchedulerError` — on any of them."""
+        if prompt_len is None:
+            prompt_len = len(req.prompt)
         if prompt_len < 1:
-            raise SchedulerError(f"request {req.rid}: empty prompt")
+            return RejectionReason(
+                RejectionCode.EMPTY_PROMPT,
+                f"request {req.rid}: empty prompt")
         if prompt_len > self.max_prompt_len:
-            raise SchedulerError(
+            return RejectionReason(
+                RejectionCode.PROMPT_TOO_LONG,
                 f"request {req.rid}: prompt {prompt_len} exceeds "
-                f"max_prompt_len {self.max_prompt_len}")
+                f"max_prompt_len {self.max_prompt_len}",
+                {"prompt_len": prompt_len,
+                 "max_prompt_len": self.max_prompt_len})
         # recompute-mode preemption replays prompt + generated-so-far as
         # the new prompt, which can grow to total - 1 tokens; a request
         # whose replay could not be re-admitted must be refused HERE —
@@ -136,24 +186,33 @@ class Scheduler:
         worst_replay = prompt_len + req.max_new_tokens \
             - len(req.out_tokens) - 1
         if worst_replay > self.max_prompt_len:
-            raise SchedulerError(
+            return RejectionReason(
+                RejectionCode.REPLAY_OVERFLOW,
                 f"request {req.rid}: preemption replay prompt can grow "
                 f"to {worst_replay} (prompt + max_new_tokens - 1), "
-                f"exceeding max_prompt_len {self.max_prompt_len}")
+                f"exceeding max_prompt_len {self.max_prompt_len}",
+                {"worst_replay": worst_replay,
+                 "max_prompt_len": self.max_prompt_len})
         total = prompt_len + req.max_new_tokens - len(req.out_tokens)
         if total > self.spec.max_seq_len:
-            raise SchedulerError(
+            return RejectionReason(
+                RejectionCode.EXCEEDS_MAX_SEQ,
                 f"request {req.rid}: prompt+max_new = {total} exceeds "
-                f"pages_per_seq*page_size = {self.spec.max_seq_len}")
+                f"pages_per_seq*page_size = {self.spec.max_seq_len}",
+                {"total": total, "max_seq_len": self.spec.max_seq_len})
         # a request the POOL can never hold must be refused at submit —
         # admitted, it would preempt every other runner one page at a
         # time and then sink the whole batch from ensure_capacity
         if self.spec.pages_for(total) > self.spec.n_usable_pages:
-            raise SchedulerError(
+            return RejectionReason(
+                RejectionCode.POOL_INFEASIBLE,
                 f"request {req.rid}: needs {self.spec.pages_for(total)} "
                 f"pages but the pool has {self.spec.n_usable_pages} "
                 "usable — it can never be served (grow num_pages or "
-                "shrink the request)")
+                "shrink the request)",
+                {"pages_needed": self.spec.pages_for(total),
+                 "n_usable_pages": self.spec.n_usable_pages})
+        return None
 
     @property
     def n_active(self) -> int:
@@ -181,7 +240,12 @@ class Scheduler:
             run = RunningSlot(req=req, prompt=list(req.prompt)
                               + list(req.out_tokens),
                               admit_seq=req.admit_seq)
-            self._validate(req, len(run.prompt))
+            reason = self.validate(req, len(run.prompt))
+            if reason is not None:
+                # unreachable for submit()-validated requests (replay
+                # growth is bounded at submit); defensive only
+                raise RejectionError(reason)
+            req.status = RequestStatus.RUNNING
             self.slots[i] = run
             admitted.append((i, run))
         return admitted
@@ -208,13 +272,21 @@ class Scheduler:
             if self.slots[i] is not run:
                 continue  # preempted / yielded earlier in this loop
             while self.slots[i] is run and self._needs_page(run):
-                page = self.allocator.alloc()
+                stolen = (self.chaos is not None
+                          and self.chaos.steal_alloc())
+                page = None if stolen else self.allocator.alloc()
                 if page is not None:
                     run.pages.append(page)
                     continue
                 victim = self._pick_victim(exclude=i)
                 if victim is None:
-                    # unreachable for validated requests (_validate
+                    if stolen:
+                        # a chaos-injected transient allocation fault
+                        # with no one to preempt: yield and retry at the
+                        # next boundary (the fault budget is finite)
+                        preempted.append(self._preempt(i))
+                        continue
+                    # unreachable for validated requests (validate()
                     # refuses pages_for(total) > n_usable_pages), so a
                     # lone runner always fits; defensive for invariant
                     # breakage only
@@ -243,6 +315,7 @@ class Scheduler:
         assert run is not None
         req = run.req
         req.preemptions += 1
+        req.status = RequestStatus.QUEUED
         self._free_slot(slot_idx)
         # recompute-mode requeue: replay prompt + already-generated
         # tokens on readmission (deterministic prefill rebuilds the
@@ -283,7 +356,8 @@ class Scheduler:
             run.pos += 1
 
     def check_invariants(self) -> None:
-        """Page accounting must balance exactly (tests)."""
+        """Page accounting must balance exactly, and the lifecycle
+        states must match occupancy (tests + chaos harness)."""
         self.allocator.check()
         held = [p for _, s in self.running() for p in s.pages]
         if len(held) != len(set(held)):
@@ -292,3 +366,15 @@ class Scheduler:
             raise AssertionError(
                 f"slot-held pages {sorted(set(held))} != allocator used "
                 f"{sorted(self.allocator._used)}")
+        # lifecycle / occupancy coherence: a terminal request must hold
+        # no capacity; queue and slots must carry the matching states
+        for req in self.waiting:
+            if req.status is not RequestStatus.QUEUED:
+                raise AssertionError(
+                    f"waiting request {req.rid} has status "
+                    f"{req.status.name}, expected QUEUED")
+        for i, run in self.running():
+            if run.req.status is not RequestStatus.RUNNING:
+                raise AssertionError(
+                    f"slot {i} request {run.req.rid} has status "
+                    f"{run.req.status.name}, expected RUNNING")

@@ -110,6 +110,18 @@ class RingBufferRecorder(NullRecorder):
         self.record({"event": "scalar", "name": str(name),
                      "value": _jsonable(value), "step": _jsonable(step)})
 
+    def events(self, kind: str) -> list:
+        """The captured records with ``record["event"] == kind`` — the
+        one-liner every chaos/robustness assertion wants ("exactly one
+        ``request_end``", "a ``hang`` with stacks", ...)."""
+        return [r for r in self.records if r.get("event") == kind]
+
+    def counts_by_event(self) -> dict:
+        """``{event: count}`` over the captured window (the overload
+        bench's reject/shed/degrade tally)."""
+        return dict(collections.Counter(
+            r.get("event", "?") for r in self.records))
+
     def __len__(self):
         return len(self.records)
 

@@ -793,6 +793,110 @@ def bench_serving():
             "prefill_decode_split": prefill_decode_split}
 
 
+def bench_serving_overload():
+    """``serving_overload`` leg: the engine under fire — a request storm
+    at ``BENCH_OVERLOAD_FACTOR`` (default 2x) the sustainable arrival
+    rate, with per-request deadlines, bounded-queue admission control
+    and degradation shedding armed (``serving.robustness``).
+
+    A calibration trace first measures the step time; the overload
+    trace then arrives at ``factor`` times the rate the slots can
+    drain (one request needs ``prompt+max_new`` slot-steps, so the
+    sustainable arrival interval is ``service_steps / n_slots`` steps).
+    What is measured is not raw throughput but the *degradation
+    contract*: **goodput** (tokens of requests completed within their
+    SLO per second), **SLO attainment** (fraction of all offered
+    requests completed in budget — rejected/shed/timed-out work counts
+    against, that is the point), p99 TTFT among completions, bounded
+    queue depth, reject/shed counts, and ZERO page leaks after the
+    storm passes.
+    """
+    import numpy as _np
+
+    from apex_tpu.serving import (
+        AdmissionConfig, DegradationPolicy, Request, ServingEngine,
+    )
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    factor = float(os.environ.get("BENCH_OVERLOAD_FACTOR", "2.0"))
+    n_req = int(os.environ.get("BENCH_OVERLOAD_REQUESTS", "24"))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    rng = _np.random.default_rng(0)
+
+    def mk(i, arrival, budget_ms=None, ttft_ms=None, priority=0):
+        return Request(
+            prompt=[int(t) for t in
+                    rng.integers(0, cfg.vocab_size, size=prompt_len)],
+            max_new_tokens=max_new, arrival_step=arrival,
+            latency_budget_ms=budget_ms, ttft_budget_ms=ttft_ms,
+            priority=priority)
+
+    eng = ServingEngine(
+        cfg, params, n_slots=n_slots,
+        admission=AdmissionConfig(max_queue=2 * n_slots,
+                                  high_watermark=0.75,
+                                  low_watermark=0.375),
+        degradation=DegradationPolicy(shed_after=3),
+        telemetry_every=0, sink=telemetry_recorder())
+    # calibration: a short saturated trace primes the compile cache AND
+    # the admission controller's EWMA step-time estimate
+    eng.generate([mk(i, 0) for i in range(min(4, n_slots))])
+    step_ms = eng.last_stats["step_ms"].get("p50") or 1.0
+
+    service_steps = prompt_len + max_new
+    sustainable_interval = max(1, service_steps // n_slots)
+    interval = max(1, int(sustainable_interval / factor))
+    # budgets scaled to the measured step time: generous enough that an
+    # un-overloaded engine would attain them, tight enough that
+    # unbounded queueing would not
+    budget_ms = service_steps * step_ms * 3.0
+    ttft_ms = prompt_len * step_ms * 4.0
+    reqs = [mk(i, i * interval, budget_ms=budget_ms, ttft_ms=ttft_ms,
+               priority=int(rng.integers(0, 3)))
+            for i in range(n_req)]
+    eng.generate(reqs, max_steps=service_steps * n_req + 1000)
+    eng.scheduler.check_invariants()
+    st = eng.last_stats
+    ttft = st["ttft_ms"]
+    return {"serving_overload": {
+        "overload_factor": factor,
+        "n_requests": n_req,
+        "arrival_interval_steps": interval,
+        "sustainable_interval_steps": sustainable_interval,
+        "goodput_tokens_per_sec": st["goodput_tokens_per_sec"],
+        "tokens_per_sec": st["tokens_per_sec"],
+        "slo_attainment": st["slo_attainment"],
+        "slo_attained": st["slo_attained"],
+        "by_status": st["by_status"],
+        "ttft_p50_ms": ttft.get("p50"),
+        "ttft_p99_ms": ttft.get("p99"),
+        "latency_budget_ms": round(budget_ms, 1),
+        "ttft_budget_ms": round(ttft_ms, 1),
+        "max_queue_depth": st["max_queue_depth"],
+        "max_queue": 2 * n_slots,
+        "preemptions": st["preemptions"],
+        "occupancy": st["occupancy"],
+        "steps": st["steps"],
+        # the leak gate: every page back in the free list after the storm
+        "page_leaks": eng.scheduler.allocator.used_count,
+        "slots": n_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "layers": layers,
+    }}
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -1225,6 +1329,22 @@ def main() -> None:
             print(f"serving bench failed: {type(e).__name__}: {e}",
                   file=_sys.stderr)
 
+    # overload leg: the same engine family at 2x the sustainable
+    # arrival rate with admission control + deadlines armed — goodput,
+    # SLO attainment, p99 TTFT, zero page leaks (serving.robustness).
+    # Gated like the serving legs (BENCH_SERVING_OVERLOAD overrides).
+    serving_overload = None
+    want_overload = os.environ.get("BENCH_SERVING_OVERLOAD", want_serving)
+    if want_overload != "0" and (not fast or want_overload == "1"):
+        try:
+            serving_overload = _retry_transient(
+                bench_serving_overload, tag="serving overload leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"serving overload bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -1293,6 +1413,7 @@ def main() -> None:
         "packed_optimizer": packed_opt,
         "serving_throughput": (serving or {}).get("serving_throughput"),
         "prefill_decode_split": (serving or {}).get("prefill_decode_split"),
+        "serving_overload": (serving_overload or {}).get("serving_overload"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

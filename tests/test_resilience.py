@@ -124,6 +124,63 @@ class TestRetry:
         retry_call(flaky, policy=policy, sleep=slept.append)
         assert slept == []
 
+    def test_deadline_bounds_total_retry_time(self):
+        """ISSUE-10 satellite: `deadline=` is an overall wall-clock
+        budget across all attempts — when elapsed + the next backoff
+        would cross it, the loop gives up early (a retry_deadline
+        event, the last exception surfaces) even with attempts left."""
+        calls, events, slept = [], [], []
+        t = {"now": 0.0}
+
+        def fake_sleep(d):
+            slept.append(d)
+            t["now"] += d
+
+        def always_fails():
+            calls.append(1)
+            t["now"] += 0.4  # each attempt burns 0.4s of fake time
+            raise OSError("down")
+
+        policy = RetryPolicy(attempts=10, retry_on=(OSError,),
+                             base_delay=1.0, max_delay=1.0,
+                             deadline=2.0,
+                             rng=__import__("random").Random(0))
+        with pytest.raises(OSError):
+            retry_call(always_fails, policy=policy, sink=events.append,
+                       sleep=fake_sleep, clock=lambda: t["now"])
+        # far fewer than 10 attempts: the budget cut it off
+        assert 1 <= len(calls) < 10
+        assert events[-1]["event"] == "retry_deadline"
+        assert events[-1]["deadline_s"] == 2.0
+        assert t["now"] < 2.0 + 1.0  # never slept past the budget
+
+    def test_deadline_none_keeps_attempt_count_semantics(self):
+        """No deadline: the historical attempts-only behaviour, every
+        attempt runs."""
+        calls = []
+        policy = RetryPolicy(attempts=3, retry_on=(OSError,))
+        with pytest.raises(OSError):
+            retry_call(lambda: calls.append(1) or
+                       (_ for _ in ()).throw(OSError("x")),
+                       policy=policy)
+        assert len(calls) == 3
+
+    def test_deadline_not_crossed_retries_normally(self):
+        """A roomy deadline changes nothing: transient retries proceed
+        and succeed."""
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("blip")
+            return "ok"
+
+        policy = RetryPolicy(attempts=5, retry_on=(OSError,),
+                             base_delay=0.001, deadline=60.0)
+        assert retry_call(flaky, policy=policy) == "ok"
+        assert len(calls) == 3
+
 
 # ---------------------------------------------------------------------------
 # checkpoint.py hardening (satellite)

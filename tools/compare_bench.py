@@ -46,6 +46,9 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("serving_p50_ms", "serving_throughput.p50_ms", False),
     ("serving_p99_ms", "serving_throughput.p99_ms", False),
     ("serving_occupancy", "serving_throughput.occupancy", True),
+    ("serving_goodput", "serving_overload.goodput_tokens_per_sec", True),
+    ("serving_slo_attainment", "serving_overload.slo_attainment", True),
+    ("serving_overload_ttft_p99_ms", "serving_overload.ttft_p99_ms", False),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
     ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
 )

@@ -20,6 +20,23 @@ tier-1 CPU lane:
                       verified — with the in-jit telemetry drain ARMED,
                       so the cond-gating is what is being audited.
 
+Chaos legs (``serving.robustness`` + ``resilience.ServingChaos`` — the
+engine must DEGRADE, not corrupt, under injected faults):
+
+- ``poison_quarantine``  a chaos-poisoned (non-finite-logits) request
+                         terminates ``FAILED`` with slot/step
+                         provenance while every other request's tokens
+                         stay identical to the dense greedy reference;
+                         zero page leaks.
+- ``timeout_eviction``   a request past its latency budget is evicted
+                         and finalized ``TIMED_OUT`` (pages freed,
+                         structured ``request_end`` event) while the
+                         unbudgeted request completes token-identically.
+- ``kill_recover``       a chaos kill mid-flight + ``recover_from``:
+                         the fresh engine replays all in-flight
+                         requests to completion, token-identical to an
+                         uninterrupted run.
+
 Usage::
 
     python tools/serving_check.py --self           # table, exit 1 on fail
@@ -147,10 +164,138 @@ def check_step_audit() -> dict:
             "codes": sorted(set(report.codes()))}
 
 
+def check_poison_quarantine() -> dict:
+    import numpy as np
+
+    from apex_tpu.resilience import ServingChaos
+    from apex_tpu.serving import (
+        Request, RequestStatus, ServingEngine, reference_decode,
+    )
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    rng = np.random.default_rng(13)
+    reqs = [
+        Request(prompt=list(rng.integers(0, cfg.vocab_size, size=L)),
+                max_new_tokens=6)
+        for L in (6, 9, 4)
+    ]
+    chaos = ServingChaos().poison_request(reqs[1].rid, at_step=7)
+    ring = RingBufferRecorder()
+    eng = ServingEngine(cfg, params, n_slots=2, num_pages=12,
+                        max_prompt_len=16, chaos=chaos, sink=ring)
+    out = eng.generate(list(reqs), max_steps=2000)
+    eng.scheduler.check_invariants()
+    victim = reqs[1]
+    mismatches = []
+    for r in (reqs[0], reqs[2]):
+        ref = reference_decode(cfg, params, r.prompt, r.max_new_tokens)
+        if out[r.rid] != ref:
+            mismatches.append({"rid": r.rid, "engine": out[r.rid],
+                               "reference": ref})
+    fails = [e for e in ring.events("request_end")
+             if e["status"] == "failed"]
+    ok = (victim.status is RequestStatus.FAILED
+          and (victim.failure or {}).get("kind") == "nonfinite_logits"
+          and (victim.failure or {}).get("step") == 7
+          and not mismatches
+          and len(fails) == 1
+          and eng.scheduler.allocator.used_count == 0)
+    return {"ok": ok, "victim_status": victim.status.value,
+            "failure": victim.failure, "mismatches": mismatches,
+            "page_leaks": eng.scheduler.allocator.used_count}
+
+
+def check_timeout_eviction() -> dict:
+    import numpy as np
+
+    from apex_tpu.serving import (
+        Request, RequestStatus, ServingEngine, VirtualClock,
+        reference_decode,
+    )
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    rng = np.random.default_rng(17)
+    free = Request(prompt=list(rng.integers(0, cfg.vocab_size, size=6)),
+                   max_new_tokens=6)
+    # one slot: the budgeted request waits behind `free` and expires
+    hurried = Request(
+        prompt=list(rng.integers(0, cfg.vocab_size, size=6)),
+        max_new_tokens=6, latency_budget_ms=5000.0)
+    ring = RingBufferRecorder()
+    eng = ServingEngine(cfg, params, n_slots=1, num_pages=8,
+                        max_prompt_len=16, clock=VirtualClock(dt=1.0),
+                        sink=ring)
+    out = eng.generate([free, hurried], max_steps=500)
+    eng.scheduler.check_invariants()
+    ref = reference_decode(cfg, params, free.prompt, free.max_new_tokens)
+    touts = [e for e in ring.events("request_end")
+             if e["status"] == "timed_out"]
+    ok = (hurried.status is RequestStatus.TIMED_OUT
+          and free.status is RequestStatus.COMPLETED
+          and out[free.rid] == ref
+          and len(touts) == 1 and touts[0]["rid"] == hurried.rid
+          and eng.scheduler.allocator.used_count == 0)
+    return {"ok": ok, "hurried_status": hurried.status.value,
+            "hurried_reason": hurried.end_reason,
+            "page_leaks": eng.scheduler.allocator.used_count}
+
+
+def check_kill_recover() -> dict:
+    import numpy as np
+
+    from apex_tpu.resilience import ChaosError, ServingChaos
+    from apex_tpu.serving import (
+        Request, RequestStatus, ServingEngine, reference_decode,
+    )
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    rng = np.random.default_rng(23)
+    reqs = [
+        Request(prompt=list(rng.integers(0, cfg.vocab_size, size=L)),
+                max_new_tokens=6, arrival_step=i)
+        for i, L in enumerate((8, 5, 11))
+    ]
+    chaos = ServingChaos().kill_engine_at(10)
+    eng = ServingEngine(cfg, params, n_slots=2, num_pages=12,
+                        max_prompt_len=16, chaos=chaos)
+    died = False
+    try:
+        eng.generate(list(reqs), max_steps=2000)
+    except ChaosError:
+        died = True
+    if not died:
+        return {"ok": False, "error": "chaos kill did not fire"}
+    eng2, survivors = ServingEngine.recover_from(eng)
+    eng2.generate(survivors, max_steps=2000)
+    eng2.scheduler.check_invariants()
+    mismatches = []
+    for r in reqs:
+        ref = reference_decode(cfg, params, r.prompt, r.max_new_tokens)
+        if list(r.out_tokens) != ref:
+            mismatches.append({"rid": r.rid, "engine": list(r.out_tokens),
+                               "reference": ref})
+    ok = (not mismatches
+          and all(r.status is RequestStatus.COMPLETED for r in reqs)
+          and len(survivors) >= 1
+          and eng2.scheduler.allocator.used_count == 0)
+    return {"ok": ok, "recovered": len(survivors),
+            "restarts": [r.restarts for r in reqs],
+            "mismatches": mismatches,
+            "page_leaks": eng2.scheduler.allocator.used_count}
+
+
 CHECKS = {
     "decode_parity": check_decode_parity,
     "token_identity": check_token_identity,
     "step_audit": check_step_audit,
+    "poison_quarantine": check_poison_quarantine,
+    "timeout_eviction": check_timeout_eviction,
+    "kill_recover": check_kill_recover,
 }
 
 
