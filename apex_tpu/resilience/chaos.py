@@ -194,6 +194,10 @@ class ServingChaos:
     - :meth:`kill_engine_at` — raise :class:`ChaosError` at a step
       boundary (the engine process dying mid-flight); recovery must
       replay the in-flight requests token-identically.
+    - :meth:`kill_replica_at` — the fleet-scale variant: kill ONE
+      replica of a :class:`~apex_tpu.serving.fleet.ReplicaFleet` at a
+      fleet step boundary; the fleet must migrate its in-flight
+      requests to the survivors token-identically (requests-lost = 0).
     - :meth:`fail_allocs` — the next N page allocations report a dry
       pool even when pages are free (a transient allocator fault),
       driving the preemption machinery spuriously; invariants must
@@ -203,6 +207,7 @@ class ServingChaos:
     def __init__(self):
         self._poison: Dict[int, Optional[int]] = {}  # rid -> step|None
         self._kill: Set[int] = set()
+        self._kill_replica: Dict[int, Set[int]] = {}  # replica -> steps
         self._wedge: Dict[int, float] = {}
         self._fail_alloc = 0
         self.faults_fired: list = []
@@ -246,6 +251,33 @@ class ServingChaos:
             self._kill.discard(int(step))
             self.faults_fired.append(("kill", int(step)))
             raise ChaosError(f"injected engine kill at step {step}")
+
+    # -- replica kill (fleet) ----------------------------------------------
+    def kill_replica_at(self, replica_id: int,
+                        *steps: int) -> "ServingChaos":
+        """Die (raise :class:`ChaosError`) when replica ``replica_id``
+        reaches these FLEET step boundaries — the one-replica-of-N
+        outage the fleet's migration path must absorb.
+
+        Steps are the fleet's LIFETIME boundary counter
+        (``ReplicaFleet.steps_run``), not per-``generate()`` offsets —
+        on a fleet reused across traces, arm against ``steps_run`` at
+        scheduling time (request ``arrival_step`` by contrast is
+        relative to its own ``generate()`` call)."""
+        self._kill_replica.setdefault(int(replica_id), set()).update(
+            int(s) for s in steps)
+        return self
+
+    def maybe_kill_replica(self, replica_id: int, step: int) -> None:
+        """Consulted by ``ReplicaFleet`` per replica per fleet step."""
+        armed = self._kill_replica.get(int(replica_id))
+        if armed and int(step) in armed:
+            armed.discard(int(step))
+            self.faults_fired.append(
+                ("kill_replica", int(replica_id), int(step)))
+            raise ChaosError(
+                f"injected replica {replica_id} kill at fleet step "
+                f"{step}")
 
     # -- wedged step sync --------------------------------------------------
     def wedge_step_at(self, step: int,

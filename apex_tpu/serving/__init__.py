@@ -26,7 +26,12 @@ training stack's own machinery:
   watermark admission control + :class:`DegradationPolicy` shedding,
   in-jit non-finite quarantine, and restart-with-replay recovery
   (``ServingEngine.recover_from``) — chaos-proven by
-  ``resilience.ServingChaos``.
+  ``resilience.ServingChaos``;
+- :mod:`~apex_tpu.serving.fleet` — :class:`ReplicaFleet`: N engines
+  behind a deadline-aware router (feasibility x load over each
+  replica's EWMA step-time cost model), drain/join rolling weight
+  swaps with zero dropped requests, and replica-kill migration riding
+  the replay carrier (requests-lost = 0, token-identical survivors).
 
 ``tools/serving_check.py --self`` is the CI smoke; ``docs/serving.md``
 the design document; ``bench.py``'s ``serving_throughput`` /
@@ -40,6 +45,11 @@ from .engine import (  # noqa: F401
     default_page_size,
 )
 from .decode_model import decode_tokens, reference_decode  # noqa: F401
+from .fleet import (  # noqa: F401
+    Replica,
+    ReplicaFleet,
+    ReplicaState,
+)
 from .kv_cache import (  # noqa: F401
     KVCacheState,
     PageAllocator,
@@ -80,6 +90,9 @@ __all__ = [
     "RejectionCode",
     "RejectionError",
     "RejectionReason",
+    "Replica",
+    "ReplicaFleet",
+    "ReplicaState",
     "Request",
     "RequestStatus",
     "RunningSlot",

@@ -70,8 +70,10 @@ from .robustness import (
     RejectionReason,
     RequestStatus,
     TransientRequestFailure,
+    already_in_flight,
     is_terminal,
     recover_requests,
+    request_expired,
 )
 from .scheduler import Request, Scheduler, SchedulerError
 
@@ -209,6 +211,20 @@ class ServingEngine:
         self.last_stats: Dict[str, Any] = {}
         self._accum = self._fresh_accum()
 
+    def begin_run(self) -> None:
+        """Reset the per-run accounting accumulators — called by
+        ``generate()`` and by fleet drivers that step the engine via
+        ``run_step`` directly, so :attr:`run_accum` describes one
+        trace, not the engine's lifetime."""
+        self._accum = self._fresh_accum()
+
+    @property
+    def run_accum(self) -> Dict[str, Any]:
+        """The current run's raw accumulators (steps, slot-step and
+        wall-time splits, queue high-water) — the public read the
+        fleet's per-replica summary folds."""
+        return self._accum
+
     @staticmethod
     def _fresh_accum() -> Dict[str, Any]:
         return {
@@ -323,6 +339,44 @@ class ServingEngine:
                 f"request {req.rid}: max_new_tokens < 1")
         return None
 
+    def probe(self, req: Request
+              ) -> Tuple[Optional[RejectionReason], float]:
+        """Read-only feasibility x cost for one request against this
+        engine — the router's view of a replica. Returns ``(reason,
+        est_steps)``:
+
+        - ``reason``: the refusal :meth:`try_submit` would produce
+          right now (engine limits, scheduler validation, admission
+          control via :meth:`AdmissionController.probe`), or ``None``
+          when the request would be admitted;
+        - ``est_steps``: estimated engine steps until this request's
+          FIRST token — current token backlog (queued + in-flight
+          remainders) shared over ``n_slots`` token-at-a-time slots,
+          plus its own replay prefill. Multiply by the controller's
+          ``estimated_step_time_s`` for a wall-clock cost.
+
+        Nothing is mutated: no ``t_arrival`` stamp, no status change,
+        no finalize, no admission latch/counter updates — a fleet
+        router costs every replica per request, and only the winner's
+        ``try_submit`` may act.
+        """
+        queued_tokens = self._queued_tokens()  # one O(queue) scan
+        backlog = queued_tokens + sum(
+            max(0, run.total_len() - run.pos)
+            for _, run in self.scheduler.running())
+        replay_len = len(req.prompt) + len(req.out_tokens)
+        est_steps = backlog / max(1, self.n_slots) + replay_len
+        if req.status in (RequestStatus.QUEUED, RequestStatus.RUNNING):
+            return already_in_flight(req), est_steps
+        reason = self._engine_reject_reason(req)
+        if reason is None:
+            reason = self.scheduler.validate(req)
+        if reason is None and self.admission is not None:
+            reason = self.admission.probe(
+                req, queue_depth=len(self.scheduler.waiting),
+                queued_tokens=queued_tokens)
+        return reason, est_steps
+
     def try_submit(self, req: Request) -> Optional[RejectionReason]:
         """Admit a request, or refuse it with a typed reason (finalized
         ``REJECTED`` + ``reject`` telemetry) — the non-raising door
@@ -339,10 +393,7 @@ class ServingEngine:
             # Request object in two queue positions / slots (shared
             # out_tokens, double finalize); refuse WITHOUT finalizing —
             # the live submission keeps running
-            reason = RejectionReason(
-                RejectionCode.ALREADY_IN_FLIGHT,
-                f"request {req.rid}: already in flight "
-                f"({req.status.value})")
+            reason = already_in_flight(req)
             self.sink.record({"event": "reject", "rid": req.rid,
                               **reason.as_record()})
             return reason
@@ -453,17 +504,7 @@ class ServingEngine:
         sched = self.scheduler
 
         def expired(req: Request) -> Optional[str]:
-            if req.t_arrival is None:
-                return None
-            age_ms = (now - req.t_arrival) * 1e3
-            if (req.latency_budget_ms is not None
-                    and age_ms > req.latency_budget_ms):
-                return "latency_budget"
-            if (req.ttft_budget_ms is not None
-                    and req.t_first_token is None
-                    and age_ms > req.ttft_budget_ms):
-                return "ttft_budget"
-            return None
+            return request_expired(req, now)
 
         for req in list(sched.waiting):
             why = expired(req)
@@ -700,7 +741,7 @@ class ServingEngine:
         is always the internal retry signal); requests still failing
         when the policy exhausts stay ``FAILED``.
         """
-        self._accum = self._fresh_accum()
+        self.begin_run()
         pending = sorted(requests, key=lambda r: (r.arrival_step, r.rid))
         all_reqs = list(pending)
         t_start = time.perf_counter()
@@ -847,6 +888,18 @@ class ServingEngine:
         return True
 
     # -- recovery ----------------------------------------------------------
+    @classmethod
+    def rebuild_like(cls, old: "ServingEngine",
+                     params: Optional[Pytree] = None) -> "ServingEngine":
+        """A fresh engine with ``old``'s config/weights/geometry/
+        policies (the captured ctor kwargs) and NO request recovery —
+        the replica-restart primitive (``ReplicaFleet.restart_replica``
+        uses it after migration already pulled the dead engine's
+        requests; see :meth:`recover_from` when the requests should
+        come along)."""
+        return cls(old.cfg, params if params is not None else old.params,
+                   **old._ctor_kw)
+
     @classmethod
     def recover_from(cls, dead: "ServingEngine", **overrides
                      ) -> Tuple["ServingEngine", List[Request]]:

@@ -85,6 +85,7 @@ class RejectionCode(enum.Enum):
     DEADLINE_INFEASIBLE = "deadline_infeasible"
     SHED = "shed"                              # degradation shed
     ALREADY_IN_FLIGHT = "already_in_flight"    # duplicate submission
+    NO_FEASIBLE_REPLICA = "no_feasible_replica"  # fleet router: none fit
 
 
 @dataclass(frozen=True)
@@ -212,6 +213,14 @@ class AdmissionController:
         return self._est_step_s
 
     @property
+    def estimated_step_time_s(self) -> float:
+        """Read-only EWMA step-time estimate (seconds; 0.0 until the
+        first measured step) — the per-replica cost model the fleet
+        router consumes. Spelled out (vs the terse :attr:`est_step_s`)
+        because it is the cross-module contract."""
+        return self._est_step_s
+
+    @property
     def backpressure(self) -> bool:
         return self._backpressure
 
@@ -241,25 +250,31 @@ class AdmissionController:
         total = (wait_steps + prompt_len + max_new) * est * 1e3
         return ttft, total
 
-    def check(self, req: "Request", *, queue_depth: int,
-              queued_tokens: int) -> Optional[RejectionReason]:
-        """Admission decision for one submit; ``None`` = admit."""
-        self.max_queue_seen = max(self.max_queue_seen, queue_depth)
+    def _next_backpressure(self, queue_depth: int) -> bool:
+        """The hysteresis latch value a submit at this depth would see
+        (ON at high, OFF only back at low) — pure function of current
+        latch + depth, shared by the mutating :meth:`check` and the
+        read-only :meth:`probe`."""
+        if self._backpressure and queue_depth <= self.low_count:
+            return False
+        if not self._backpressure and queue_depth >= self.high_count:
+            return True
+        return self._backpressure
+
+    def _admission_reason(self, req: "Request", queue_depth: int,
+                          queued_tokens: int, backpressure: bool
+                          ) -> Optional[RejectionReason]:
+        """The admission verdict for one submit, given an (already
+        resolved) hysteresis state; ``None`` = admit. Pure — no counter
+        or latch updates."""
         if queue_depth >= self.config.max_queue:
-            self.rejected += 1
             return RejectionReason(
                 RejectionCode.QUEUE_FULL,
                 f"request {req.rid}: queue full "
                 f"({queue_depth}/{self.config.max_queue})",
                 {"queue_depth": queue_depth,
                  "max_queue": self.config.max_queue})
-        # watermark hysteresis: ON at high, OFF only back at low
-        if self._backpressure and queue_depth <= self.low_count:
-            self._backpressure = False
-        elif not self._backpressure and queue_depth >= self.high_count:
-            self._backpressure = True
-        if self._backpressure:
-            self.rejected += 1
+        if backpressure:
             return RejectionReason(
                 RejectionCode.BACKPRESSURE,
                 f"request {req.rid}: backpressure (queue {queue_depth} >= "
@@ -275,7 +290,6 @@ class AdmissionController:
         if lat_lb is not None:
             if (req.latency_budget_ms is not None
                     and lat_lb > req.latency_budget_ms):
-                self.rejected += 1
                 return RejectionReason(
                     RejectionCode.DEADLINE_INFEASIBLE,
                     f"request {req.rid}: estimated latency lower bound "
@@ -284,9 +298,14 @@ class AdmissionController:
                     {"latency_lb_ms": round(lat_lb, 1),
                      "latency_budget_ms": req.latency_budget_ms,
                      "est_step_ms": round(self._est_step_s * 1e3, 3)})
+            # TTFT infeasibility only while the first token is still
+            # owed (same rule as pick_shed_victim): a re-admitted
+            # request that already attained its TTFT — a preempted,
+            # recovered, or fleet-migrated survivor — must not be
+            # refused against a deadline it already met
             if (req.ttft_budget_ms is not None
+                    and req.t_first_token is None
                     and ttft_lb > req.ttft_budget_ms):
-                self.rejected += 1
                 return RejectionReason(
                     RejectionCode.DEADLINE_INFEASIBLE,
                     f"request {req.rid}: estimated TTFT lower bound "
@@ -296,6 +315,36 @@ class AdmissionController:
                      "ttft_budget_ms": req.ttft_budget_ms,
                      "est_step_ms": round(self._est_step_s * 1e3, 3)})
         return None
+
+    def check(self, req: "Request", *, queue_depth: int,
+              queued_tokens: int) -> Optional[RejectionReason]:
+        """Admission decision for one submit; ``None`` = admit.
+        Mutating: latches the watermark hysteresis and counts
+        rejections — this is the door a request actually walks
+        through. Use :meth:`probe` for advisory routing queries."""
+        self.max_queue_seen = max(self.max_queue_seen, queue_depth)
+        # queue-full precedes the latch update (a hard-bound refusal
+        # does not flip hysteresis state — historical behaviour)
+        if queue_depth < self.config.max_queue:
+            self._backpressure = self._next_backpressure(queue_depth)
+        reason = self._admission_reason(req, queue_depth, queued_tokens,
+                                        self._backpressure)
+        if reason is not None:
+            self.rejected += 1
+        return reason
+
+    def probe(self, req: "Request", *, queue_depth: int,
+              queued_tokens: int) -> Optional[RejectionReason]:
+        """The verdict :meth:`check` WOULD return for this submit,
+        without acting through admission side effects: no hysteresis
+        latch flip, no rejection counters, no high-water marks. The
+        fleet router costs every replica per request — a mutating
+        feasibility sweep would latch backpressure (or pad the reject
+        tally) on replicas the request never touches."""
+        return self._admission_reason(
+            req, queue_depth, queued_tokens,
+            self._next_backpressure(queue_depth)
+            if queue_depth < self.config.max_queue else self._backpressure)
 
     # -- degradation ---------------------------------------------------------
     @property
@@ -353,6 +402,40 @@ class AdmissionController:
                     and ttft_lb > req.ttft_budget_ms):
                 return req
         return min(waiting, key=lambda r: (r.priority, -r.rid))
+
+
+def already_in_flight(req: "Request",
+                      where: Optional[str] = None) -> RejectionReason:
+    """The duplicate-submission refusal — ONE constructor for the
+    engine's submit/probe doors and the fleet's (which also fires for
+    fleet-owned migrants, passing ``where="awaiting migration"`` since
+    their status reads ``pending``)."""
+    return RejectionReason(
+        RejectionCode.ALREADY_IN_FLIGHT,
+        f"request {req.rid}: already in flight "
+        f"({where or req.status.value})")
+
+
+def request_expired(req: "Request", now: float) -> Optional[str]:
+    """Which deadline (if any) this request has blown at ``now``:
+    ``"latency_budget"`` past its total budget, ``"ttft_budget"``
+    still owed a first token past its TTFT budget, else ``None``.
+
+    THE deadline predicate: the engine's boundary eviction and the
+    fleet's migrant expiry both call it, so a request times out under
+    one rule wherever it happens to be waiting.
+    """
+    if req.t_arrival is None:
+        return None
+    age_ms = (now - req.t_arrival) * 1e3
+    if (req.latency_budget_ms is not None
+            and age_ms > req.latency_budget_ms):
+        return "latency_budget"
+    if (req.ttft_budget_ms is not None
+            and req.t_first_token is None
+            and age_ms > req.ttft_budget_ms):
+        return "ttft_budget"
+    return None
 
 
 class TransientRequestFailure(RuntimeError):

@@ -78,6 +78,10 @@ class Request:
     failure: Optional[dict] = None
     retries: int = 0
     restarts: int = 0
+    # fleet routing: the replica that last admitted this request (None
+    # outside fleet serving / before dispatch) — summary attribution
+    # and the migration trail both key on it
+    replica_id: Optional[int] = None
     # seniority, assigned at FIRST admission and stable across
     # preemptions — the total order that makes preemption terminate
     # (younger never preempts older, so the most senior request always

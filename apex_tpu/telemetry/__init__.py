@@ -52,6 +52,7 @@ from .recorder import (  # noqa: F401
     MultiRecorder,
     NullRecorder,
     RingBufferRecorder,
+    TaggedRecorder,
     is_logging_process,
     percentiles,
     read_jsonl,
@@ -76,8 +77,8 @@ __all__ = [
     "TickTimeline", "analytic_bubble_fraction", "bubble_report",
     "classify_phase", "schedule_ticks", "tick_phases",
     "JsonlRecorder", "MultiRecorder", "NullRecorder",
-    "RingBufferRecorder", "is_logging_process", "percentiles",
-    "read_jsonl",
+    "RingBufferRecorder", "TaggedRecorder", "is_logging_process",
+    "percentiles", "read_jsonl",
     "TraceSession", "aggregate_op_times", "breakdown_table",
     "categorize_op", "cost_analysis_breakdown", "parse_xspace_op_times",
     "profile_step", "short_op_name", "trace_session",

@@ -177,6 +177,38 @@ class JsonlRecorder(NullRecorder):
                 self._fh = None
 
 
+class TaggedRecorder(NullRecorder):
+    """Inject fixed key/value tags into every record before forwarding.
+
+    The attribution shim for multi-instance telemetry: a replica fleet
+    hands each ``ServingEngine`` a ``TaggedRecorder(sink,
+    replica_id=i)`` so every ``request_end`` / ``hang`` / quarantine /
+    ``serving_step`` event lands in the shared stream carrying the
+    replica that emitted it — fleet traces stay attributable without
+    threading an id through every ``record`` call site. A record's own
+    keys win over the tags (an event that already carries
+    ``replica_id`` keeps it); ``add_scalar`` writes are tagged too (as
+    ``scalar`` records, like the ring buffer does).
+    """
+
+    def __init__(self, sink, tags: Optional[dict] = None, **tag_kw):
+        self.sink = sink
+        self.tags = {**(tags or {}), **tag_kw}
+
+    def record(self, rec: dict) -> None:
+        self.sink.record({**self.tags, **rec})
+
+    def add_scalar(self, name, value, step) -> None:
+        self.record({"event": "scalar", "name": str(name),
+                     "value": _jsonable(value), "step": _jsonable(step)})
+
+    def flush(self) -> None:
+        self.sink.flush()
+
+    def close(self) -> None:
+        self.sink.close()
+
+
 class MultiRecorder(NullRecorder):
     """Fan a record out to several sinks (e.g. JSONL + ring buffer)."""
 

@@ -897,6 +897,131 @@ def bench_serving_overload():
     }}
 
 
+def bench_serving_fleet():
+    """``serving_fleet`` leg: the replica fleet under a mid-run outage
+    (``serving.fleet`` — ISSUE-11).
+
+    A Zipfian request trace (a few long shared-head prompts, a long
+    tail of short ones — the shape of real multi-tenant traffic)
+    arrives at ``BENCH_FLEET_LOAD`` (default 0.8x) of the FLEET's
+    aggregate capacity across ``BENCH_FLEET_REPLICAS`` (default 3)
+    replicas; ``ServingChaos.kill_replica_at`` kills one replica
+    mid-run. What is measured is the failover contract, not raw
+    speed: fleet **SLO attainment** over all offered requests,
+    **goodput**, p99 TTFT among completions, migration counts — and
+    **requests_lost, which must be 0**: every in-flight request of
+    the dead replica rides the replay carrier onto a survivor and
+    completes (token-identity is pinned by the tier-1 tests; the
+    bench pins the accounting at scale).
+    """
+    import numpy as _np
+
+    from apex_tpu.resilience import RetryPolicy, ServingChaos
+    from apex_tpu.serving import (
+        AdmissionConfig, DegradationPolicy, ReplicaFleet, Request,
+        ServingEngine,
+    )
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    load = float(os.environ.get("BENCH_FLEET_LOAD", "0.8"))
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "24"))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    rng = _np.random.default_rng(0)
+
+    # Zipfian prompt lengths: rank-1 mass keeps the full prompt (the
+    # shared long head), higher ranks shrink it — a long tail of short
+    # prompts around a few heavy ones
+    def zipf_len():
+        z = int(rng.zipf(1.5))
+        return max(8, min(prompt_len, prompt_len // z))
+
+    def mk(arrival, plen, budget_ms=None, ttft_ms=None, priority=0):
+        return Request(
+            prompt=[int(t) for t in
+                    rng.integers(0, cfg.vocab_size, size=plen)],
+            max_new_tokens=max_new, arrival_step=arrival,
+            latency_budget_ms=budget_ms, ttft_budget_ms=ttft_ms,
+            priority=priority)
+
+    # calibration on a throwaway single engine: prime the compile cache
+    # and measure the step time the budgets scale from
+    calib = ServingEngine(cfg, params, n_slots=n_slots)
+    calib.generate([mk(0, prompt_len) for _ in range(min(4, n_slots))])
+    step_ms = calib.last_stats["step_ms"].get("p50") or 1.0
+    del calib
+
+    plens = [zipf_len() for _ in range(n_req)]
+    mean_service = sum(plens) / len(plens) + max_new
+    # the fleet drains n_replicas * n_slots tokens per fleet step;
+    # arrivals at `load` of that capacity
+    interval = max(1, int(round(
+        mean_service / (n_slots * n_replicas) / load)))
+    budget_ms = (prompt_len + max_new) * step_ms * 4.0
+    ttft_ms = prompt_len * step_ms * 5.0
+    reqs = [mk(i * interval, plens[i], budget_ms=budget_ms,
+               ttft_ms=ttft_ms, priority=int(rng.integers(0, 3)))
+            for i in range(n_req)]
+    kill_step = max(2, (n_req // 2) * interval)
+    chaos = ServingChaos().kill_replica_at(1, kill_step)
+    fleet = ReplicaFleet(
+        cfg, params, n_replicas=n_replicas, chaos=chaos,
+        sink=telemetry_recorder(),
+        migration_retry=RetryPolicy(attempts=10_000,
+                                    deadline=budget_ms / 1e3),
+        n_slots=n_slots,
+        admission=AdmissionConfig(max_queue=4 * n_slots,
+                                  high_watermark=0.75,
+                                  low_watermark=0.375),
+        degradation=DegradationPolicy(shed_after=3))
+    fleet.generate(
+        reqs, max_steps=(prompt_len + max_new) * n_req + 2000)
+    fleet.check_invariants()
+    st = fleet.last_stats
+    ttft = st["ttft_ms"]
+    return {"serving_fleet": {
+        "n_replicas": n_replicas,
+        "load_factor": load,
+        "n_requests": n_req,
+        "arrival_interval_steps": interval,
+        "kill_step": kill_step,
+        "killed_replica": 1,
+        "replica_deaths": st["replica_deaths"],
+        "migrated": st["migrated"],
+        "migration_readmitted": st["migration_readmitted"],
+        # the zero-loss gate compare_bench tracks absolutely
+        "requests_lost": st["requests_lost"],
+        "slo_attainment": st["slo_attainment"],
+        "slo_attained": st["slo_attained"],
+        "goodput_tokens_per_sec": st["goodput_tokens_per_sec"],
+        "tokens_per_sec": st["tokens_per_sec"],
+        "by_status": st["by_status"],
+        "ttft_p50_ms": ttft.get("p50"),
+        "ttft_p99_ms": ttft.get("p99"),
+        "latency_budget_ms": round(budget_ms, 1),
+        "ttft_budget_ms": round(ttft_ms, 1),
+        "steps": st["steps"],
+        "page_leaks": fleet.page_leaks(),
+        "per_replica": st["per_replica"],
+        "slots": n_slots,
+        "prompt_len_max": prompt_len,
+        "prompt_len_mean": round(sum(plens) / len(plens), 1),
+        "max_new_tokens": max_new,
+        "layers": layers,
+    }}
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -1345,6 +1470,22 @@ def main() -> None:
             print(f"serving overload bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # fleet leg: N replicas behind the deadline-aware router, one
+    # killed mid-run — fleet SLO attainment, goodput, p99 TTFT, and
+    # requests_lost (must be 0; compare_bench gates it absolutely).
+    # Gated like the serving legs (BENCH_SERVING_FLEET overrides).
+    serving_fleet = None
+    want_fleet = os.environ.get("BENCH_SERVING_FLEET", want_serving)
+    if want_fleet != "0" and (not fast or want_fleet == "1"):
+        try:
+            serving_fleet = _retry_transient(
+                bench_serving_fleet, tag="serving fleet leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"serving fleet bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -1414,6 +1555,7 @@ def main() -> None:
         "serving_throughput": (serving or {}).get("serving_throughput"),
         "prefill_decode_split": (serving or {}).get("prefill_decode_split"),
         "serving_overload": (serving_overload or {}).get("serving_overload"),
+        "serving_fleet": (serving_fleet or {}).get("serving_fleet"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

@@ -619,6 +619,83 @@ def test_chaos_property_traces_hold_invariants_every_step(tiny_model):
             "the poisoned request must not complete normally")
 
 
+def test_recover_from_under_admission_pressure(tiny_model):
+    """ISSUE-11 satellite: recover_from composed with admission
+    pressure. A killed engine's survivors land on an engine whose
+    queue already sits at the high watermark: re-admission must not
+    deadlock or leak pages — the recovered work either queues (when
+    the door opens) or is refused/shed in DegradationPolicy order
+    (lowest-priority-youngest), with check_invariants() holding after
+    every step and every request terminal."""
+    cfg, params = tiny_model
+    rng = np.random.default_rng(53)
+    # engine A dies mid-flight with work running and queued
+    chaos = ServingChaos().kill_engine_at(4)
+    eng_a = ServingEngine(cfg, params, n_slots=1, num_pages=8,
+                          max_prompt_len=16, chaos=chaos)
+    a_reqs = [Request(prompt=_toks(rng, 5), max_new_tokens=5,
+                      priority=3) for _ in range(2)]
+    with pytest.raises(ChaosError):
+        eng_a.generate(list(a_reqs), max_steps=500)
+    from apex_tpu.serving import recover_requests
+
+    survivors = recover_requests(eng_a)
+    assert survivors, "the kill must strand work"
+    # engine B: bounded queue ALREADY at the high watermark (4 of
+    # max_queue 8, high=0.5), slot pinned by a hog, shedding armed
+    ring = RingBufferRecorder()
+    eng_b = ServingEngine(
+        cfg, params, n_slots=1, num_pages=8, max_prompt_len=16,
+        sink=ring,
+        admission=AdmissionConfig(max_queue=8, high_watermark=0.5,
+                                  low_watermark=0.25),
+        degradation=DegradationPolicy(shed_after=2))
+    hog = Request(prompt=_toks(rng, 4), max_new_tokens=10)
+    eng_b.submit(hog)
+    eng_b.run_step()  # hog takes the slot
+    primed = [Request(prompt=_toks(rng, 4), max_new_tokens=5,
+                      priority=p) for p in (2, 1, 0, 2)]
+    for q in primed:
+        assert eng_b.try_submit(q) is None
+    assert len(eng_b.scheduler.waiting) == eng_b.admission.high_count
+    # recovered work re-enters through the same admission door: at the
+    # high watermark it is refused typed (BACKPRESSURE), never dropped
+    readmitted, refused = [], []
+    for r in survivors:
+        reason = eng_b.try_submit(r)
+        (refused if reason is not None else readmitted).append(r)
+        if reason is not None:
+            assert reason.code is RejectionCode.BACKPRESSURE
+            assert r.status is RequestStatus.REJECTED
+    assert refused, "pressure must push back on recovery"
+    # drive to drain with invariants checked after EVERY step; the
+    # sustained pressure sheds queued work in DegradationPolicy order
+    guard = 0
+    while not eng_b.scheduler.idle:
+        guard += 1
+        assert guard < 400, "recovery-under-pressure deadlocked"
+        eng_b.run_step()
+        eng_b.scheduler.check_invariants()
+    shed = [e for e in ring.events("shed")]
+    assert shed, "sustained pressure must shed"
+    shed_reqs = [q for q in primed if q.end_reason == "shed"]
+    assert shed_reqs and min(q.priority for q in primed) in {
+        q.priority for q in shed_reqs}, (
+        "shedding must take the lowest-priority victims first")
+    assert shed[0]["priority"] == min(
+        q.priority for q in primed)
+    for r in [hog] + primed + survivors:
+        assert is_terminal(r.status), (r.rid, r.status)
+    assert eng_b.scheduler.allocator.used_count == 0
+    # the recovered request that got through completed token-identical
+    # (replay carried its pre-kill tokens across BOTH the kill and the
+    # pressure) — the composition the satellite pins
+    for r in readmitted:
+        if r.status is RequestStatus.COMPLETED:
+            assert list(r.out_tokens) == reference_decode(
+                cfg, params, r.prompt, r.max_new_tokens), r.rid
+
+
 # ---------------------------------------------------------------------------
 # CI wiring: serving_check chaos legs + compare_bench overload legs
 # ---------------------------------------------------------------------------

@@ -49,6 +49,10 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("serving_goodput", "serving_overload.goodput_tokens_per_sec", True),
     ("serving_slo_attainment", "serving_overload.slo_attainment", True),
     ("serving_overload_ttft_p99_ms", "serving_overload.ttft_p99_ms", False),
+    ("fleet_slo_attainment", "serving_fleet.slo_attainment", True),
+    ("fleet_goodput", "serving_fleet.goodput_tokens_per_sec", True),
+    ("fleet_requests_lost", "serving_fleet.requests_lost", False),
+    ("fleet_ttft_p99_ms", "serving_fleet.ttft_p99_ms", False),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
     ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
 )
@@ -59,6 +63,10 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
 ABS_TOLERANCE = {
     "telemetry_overhead_pct": 1.0,  # percentage points (the <=1% claim)
     "resilience_overhead_pct": 1.0,  # ditto (docs/resilience.md)
+    # the zero-loss failover contract: the expected value is exactly 0,
+    # so ONE lost request must regress — a relative threshold over a
+    # zero base would wave any count through (or inf-flag noise)
+    "fleet_requests_lost": 0.5,  # requests (docs/serving.md fleet)
 }
 
 # op-breakdown category diffing (ISSUE-9): a run whose *shape* of device

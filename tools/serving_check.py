@@ -37,6 +37,21 @@ engine must DEGRADE, not corrupt, under injected faults):
                          requests to completion, token-identical to an
                          uninterrupted run.
 
+Fleet legs (``serving.fleet`` — ISSUE-11: the multi-replica router
+must hold the zero-loss contract under replica outages):
+
+- ``fleet_kill_migrate``  3 CPU-faked replicas, one killed mid-storm
+                          by ``ServingChaos.kill_replica_at``: every
+                          in-flight request of the dead replica
+                          migrates to the survivors on the replay
+                          carrier and completes token-identical to an
+                          undisturbed run — requests_lost MUST be 0.
+- ``fleet_drain_join``    a rolling weight update mid-traffic: each
+                          replica drains, swaps weights via
+                          ``cast_params_for_inference``, rejoins —
+                          zero dropped requests, and post-update
+                          traffic decodes per the NEW weights.
+
 Usage::
 
     python tools/serving_check.py --self           # table, exit 1 on fail
@@ -289,8 +304,118 @@ def check_kill_recover() -> dict:
             "page_leaks": eng2.scheduler.allocator.used_count}
 
 
+def check_fleet_kill_migrate() -> dict:
+    import numpy as np
+
+    from apex_tpu.resilience import ServingChaos
+    from apex_tpu.serving import (
+        ReplicaFleet, ReplicaState, Request, RequestStatus,
+        reference_decode,
+    )
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    rng = np.random.default_rng(29)
+    reqs = [
+        Request(prompt=list(rng.integers(0, cfg.vocab_size,
+                                         size=int(rng.integers(4, 12)))),
+                max_new_tokens=6, arrival_step=i)
+        for i in range(9)
+    ]
+    chaos = ServingChaos().kill_replica_at(1, 6)
+    ring = RingBufferRecorder()
+    fleet = ReplicaFleet(cfg, params, n_replicas=3, sink=ring,
+                         chaos=chaos, n_slots=2, num_pages=12,
+                         max_prompt_len=24)
+    out = fleet.generate(reqs, max_steps=3000)
+    fleet.check_invariants()
+    st = fleet.last_stats
+    migrated_rids = {e["rid"] for e in ring.events("migrate")}
+    mismatches = []
+    for r in reqs:
+        ref = reference_decode(cfg, params, r.prompt, r.max_new_tokens)
+        if out[r.rid] != ref:
+            mismatches.append({"rid": r.rid, "engine": out[r.rid],
+                               "reference": ref})
+    ok = (st["replica_deaths"] == 1
+          and st["requests_lost"] == 0
+          and st["migrated"] >= 1
+          and bool(migrated_rids)
+          and fleet.replicas[1].state is ReplicaState.DEAD
+          and not mismatches
+          and all(r.status is RequestStatus.COMPLETED for r in reqs)
+          and fleet.page_leaks() == 0)
+    return {"ok": ok, "requests_lost": st["requests_lost"],
+            "migrated": st["migrated"],
+            "replica_deaths": st["replica_deaths"],
+            "mismatches": mismatches, "page_leaks": fleet.page_leaks()}
+
+
+def check_fleet_drain_join() -> dict:
+    import jax
+    import numpy as np
+
+    from apex_tpu.serving import (
+        ReplicaFleet, Request, RequestStatus, reference_decode,
+    )
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    params2 = jax.tree_util.tree_map(lambda x: x, params)
+    params2["embedding"]["position"] = (
+        params["embedding"]["position"] * 0.5)
+    rng = np.random.default_rng(31)
+    ring = RingBufferRecorder()
+    fleet = ReplicaFleet(cfg, params, n_replicas=2, sink=ring,
+                         n_slots=2, num_pages=12, max_prompt_len=16)
+    phase1 = [Request(prompt=list(rng.integers(0, cfg.vocab_size,
+                                               size=6)),
+                      max_new_tokens=5, arrival_step=i)
+              for i in range(4)]
+    fleet.schedule_rolling_update(params2)
+    out1 = fleet.generate(phase1, max_steps=2000)
+    st = fleet.last_stats
+    swaps = ring.events("weight_swap")
+    # zero-drop contract: every phase-1 request completed (on the old
+    # or new weights, depending on when its replica swapped)
+    drops = [r.rid for r in phase1
+             if r.status is not RequestStatus.COMPLETED]
+    mismatches = []
+    for r in phase1:
+        refs = (reference_decode(cfg, params, r.prompt,
+                                 r.max_new_tokens),
+                reference_decode(cfg, params2, r.prompt,
+                                 r.max_new_tokens))
+        if out1[r.rid] not in refs:
+            mismatches.append({"rid": r.rid, "engine": out1[r.rid]})
+    # post-update traffic must decode per the NEW weights everywhere
+    phase2 = [Request(prompt=list(rng.integers(0, cfg.vocab_size,
+                                               size=6)),
+                      max_new_tokens=5) for _ in range(4)]
+    out2 = fleet.generate(phase2, max_steps=2000)
+    for r in phase2:
+        ref2 = reference_decode(cfg, params2, r.prompt,
+                                r.max_new_tokens)
+        if out2[r.rid] != ref2:
+            mismatches.append({"rid": r.rid, "engine": out2[r.rid],
+                               "reference": ref2})
+    ok = (fleet.rolling_update_done
+          and len(swaps) == 2
+          and not drops
+          and st["requests_lost"] == 0
+          and not mismatches
+          and fleet.page_leaks() == 0)
+    return {"ok": ok, "swaps": len(swaps), "dropped": drops,
+            "requests_lost": st["requests_lost"],
+            "mismatches": mismatches, "page_leaks": fleet.page_leaks()}
+
+
 CHECKS = {
     "decode_parity": check_decode_parity,
+    "fleet_kill_migrate": check_fleet_kill_migrate,
+    "fleet_drain_join": check_fleet_drain_join,
     "token_identity": check_token_identity,
     "step_audit": check_step_audit,
     "poison_quarantine": check_poison_quarantine,
