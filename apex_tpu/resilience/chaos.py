@@ -202,6 +202,12 @@ class ServingChaos:
       pool even when pages are free (a transient allocator fault),
       driving the preemption machinery spuriously; invariants must
       hold and every request still terminate.
+    - :meth:`evict_prefix_cache` — eviction-under-pressure: force the
+      engine to run N prefix-cache evictions at its next boundary even
+      though the pool is not actually dry. ``evict_one`` must still
+      refuse to free any page a live reader holds — the property the
+      chaos trace proves, combined with :meth:`fail_allocs` driving
+      real pressure through the same path.
     """
 
     def __init__(self):
@@ -210,6 +216,7 @@ class ServingChaos:
         self._kill_replica: Dict[int, Set[int]] = {}  # replica -> steps
         self._wedge: Dict[int, float] = {}
         self._fail_alloc = 0
+        self._cache_evict = 0
         self.faults_fired: list = []
 
     # -- poisoned logits ---------------------------------------------------
@@ -306,6 +313,23 @@ class ServingChaos:
             self.faults_fired.append(("alloc", None))
             return True
         return False
+
+    # -- prefix-cache eviction under pressure ------------------------------
+    def evict_prefix_cache(self, n: int) -> "ServingChaos":
+        """Force ``n`` prefix-cache evictions at the engine's next
+        scheduling boundary — synthetic pool pressure aimed straight at
+        the eviction path (``PrefixCache.evict_one`` must never free a
+        page a live reader holds, pressured or not)."""
+        self._cache_evict += int(n)
+        return self
+
+    def take_cache_evictions(self) -> int:
+        """Consulted by ``ServingEngine.run_step`` per boundary: how
+        many forced evictions to run now (the budget drains once)."""
+        n, self._cache_evict = self._cache_evict, 0
+        if n:
+            self.faults_fired.append(("cache_evict", n))
+        return n
 
 
 def request_storm(engine, seed: int = 0) -> List[tuple]:

@@ -7,19 +7,27 @@ training stack's own machinery:
 - :mod:`~apex_tpu.serving.kv_cache` — the paged KV cache:
   :class:`PagedKVSpec` lays the page pools out as chunk-aligned packed
   buffers on ``multi_tensor_apply.packing.PackSpec`` (one page = one
-  chunk; ``analysis.check_pack_spec`` verifies it), plus the host-side
-  :class:`PageAllocator` free list;
-- :mod:`~apex_tpu.serving.decode_model` — token-at-a-time GPT forward
-  against the cache, attention by ``ops.flash_decode`` (online-softmax
-  across pages, Pallas scalar-prefetch kernel with XLA fallback);
+  chunk; ``analysis.check_pack_spec`` verifies it), the host-side
+  :class:`PageAllocator` (reader refcounts + cache pins + COW fork),
+  and :class:`PrefixCache` — the radix/hash prefix index keying pages
+  by the hash of the token prefix through them, so shared prompt heads
+  skip their prefill (vLLM block reuse x SGLang RadixAttention);
+- :mod:`~apex_tpu.serving.decode_model` — token-at-a-time AND
+  chunked-prefill GPT forwards against the cache, attention by
+  ``ops.flash_decode`` (online-softmax across pages, Pallas
+  scalar-prefetch kernel with XLA fallback; the chunk flattens into a
+  single-query batch with per-column kv_lens = causal by construction);
 - :mod:`~apex_tpu.serving.scheduler` — Orca-style iteration-level
-  continuous batching: admit/evict between steps, lazy page allocation,
-  recompute-mode preemption when the pool runs dry;
-- :mod:`~apex_tpu.serving.engine` — :class:`ServingEngine`: ONE jitted
-  fixed-shape step interleaving prefill and decode (each slot consumes
-  one token per step), KV/slot/metrics state donated, sampled tokens
-  fed back on device, telemetry through the PR-2 cond-gated drain, and
-  the PR-4 auditor as the invariant gate (``engine.audit()``);
+  continuous batching: admit/evict between steps, lazy chunk-aware
+  page allocation, prefix-cache acquisition/publication/COW-forking,
+  cache-eviction-before-preemption under pool pressure, recompute-mode
+  preemption when the pool runs dry;
+- :mod:`~apex_tpu.serving.engine` — :class:`ServingEngine`: jitted
+  fixed-shape steps interleaving prefill and decode (one token per
+  slot-step; up to ``prefill_chunk`` prompt tokens while prefilling),
+  KV/slot/metrics state donated, sampled tokens fed back on device,
+  telemetry through the PR-2 cond-gated drain, and the PR-4 auditor as
+  the invariant gate (``engine.audit()`` — both programs);
 - :mod:`~apex_tpu.serving.robustness` — serving under fire: the typed
   request lifecycle (``RequestStatus``), per-request TTFT/latency
   deadlines, one :class:`RejectionReason` taxonomy for every refusal,
@@ -44,7 +52,11 @@ from .engine import (  # noqa: F401
     SlotState,
     default_page_size,
 )
-from .decode_model import decode_tokens, reference_decode  # noqa: F401
+from .decode_model import (  # noqa: F401
+    decode_tokens,
+    prefill_chunk_tokens,
+    reference_decode,
+)
 from .fleet import (  # noqa: F401
     Replica,
     ReplicaFleet,
@@ -54,7 +66,9 @@ from .kv_cache import (  # noqa: F401
     KVCacheState,
     PageAllocator,
     PagedKVSpec,
+    PrefixCache,
     page_table_row,
+    write_chunk_kv,
     write_token_kv,
 )
 from .robustness import (  # noqa: F401
@@ -87,6 +101,7 @@ __all__ = [
     "POISONED",
     "PageAllocator",
     "PagedKVSpec",
+    "PrefixCache",
     "RejectionCode",
     "RejectionError",
     "RejectionReason",
@@ -107,7 +122,9 @@ __all__ = [
     "default_page_size",
     "is_terminal",
     "page_table_row",
+    "prefill_chunk_tokens",
     "recover_requests",
     "reference_decode",
+    "write_chunk_kv",
     "write_token_kv",
 ]

@@ -1,18 +1,35 @@
-"""Token-at-a-time GPT forward over the paged KV cache.
+"""Token-at-a-time AND chunked-prefill GPT forward over the paged KV
+cache.
 
 The inference twin of
 ``transformer.testing.standalone_transformer_lm``: same parameter
 pytree (``init_gpt_params``), same per-layer math (pre-LN, fused-QKV
-attention, GeLU MLP, tied-embedding head), but evaluated for ONE token
-per slot against K/V read from — and appended to — the paged pool
-(``serving.kv_cache``), with attention by ``ops.flash_decode``.
+attention, GeLU MLP, tied-embedding head), but evaluated against K/V
+read from — and appended to — the paged pool (``serving.kv_cache``),
+with attention by ``ops.flash_decode``.
 
-Everything is fixed-shape over the ``[n_slots]`` slot batch; per-slot
-variation (prefill vs decode, active vs idle) is select-gated so the one
-compiled program serves any mix — the Orca-style single-program
-iteration the scheduler batches into. Inactive slots index the reserved
-garbage page and contribute zero attention (``kv_lens == 0``), so no
-host branching ever reshapes the program.
+Two entry points, both fixed-shape over the ``[n_slots]`` slot batch:
+
+- :func:`decode_tokens` — ONE token per slot per step (the pure-decode
+  hot path; zero padding waste);
+- :func:`prefill_chunk_tokens` — up to ``chunk`` tokens per slot per
+  step: a prefilling slot ingests a dynamic slice of its prompt
+  buffer, a decoding slot rides along consuming its one carried token
+  in column 0, idle columns are masked to the garbage page. In-chunk
+  attention is **causal by construction**: each chunk column's K/V is
+  scattered into the pool BEFORE attention runs, and column ``j``
+  attends with ``kv_len = pos + j + 1`` — so flattening the ``[B, C]``
+  chunk into a ``[B*C]`` single-query batch reuses ``flash_decode``
+  verbatim (per-column kv_lens do the causal masking; the kernel grid
+  just grows its slot axis). Per-row math is identical to the
+  token-at-a-time step — chunked prefill is token-identical to
+  single-token prefill, the oracle ``tools/serving_check.py`` pins.
+
+Per-slot variation (prefill vs decode, active vs idle) is select-gated
+so each compiled program serves any mix — the Orca-style
+single-program iteration the scheduler batches into. Inactive slots
+index the reserved garbage page and contribute zero attention
+(``kv_lens == 0``), so no host branching ever reshapes a program.
 
 Dtype discipline mirrors training: LayerNorm in fp32, GEMMs in
 ``cfg.compute_dtype``, logits fp32 (``_lm_head`` parity) — so a bf16
@@ -27,7 +44,12 @@ import jax.numpy as jnp
 
 from ..ops.flash_decode import flash_decode
 from ..ops.layer_norm import layer_norm as fused_layer_norm
-from .kv_cache import KVCacheState, PagedKVSpec, write_token_kv
+from .kv_cache import (
+    KVCacheState,
+    PagedKVSpec,
+    write_chunk_kv,
+    write_token_kv,
+)
 
 Pytree = Any
 
@@ -128,6 +150,131 @@ def decode_tokens(
         preferred_element_type=jnp.float32,
     )
     return logits, KVCacheState(pages=pages)
+
+
+def prefill_chunk_tokens(
+    cfg,
+    params: Pytree,
+    spec: PagedKVSpec,
+    kv: KVCacheState,
+    tokens: jax.Array,       # [B] int32 — decode slots' carried token
+    positions: jax.Array,    # [B] int32 — tokens already cached
+    active: jax.Array,       # [B] bool
+    prompt_buf: jax.Array,   # [B, W] int32 — replay prompt text
+    prompt_lens: jax.Array,  # [B] int32
+    page_tables: jax.Array,  # [B, pages_per_seq] int32
+    *,
+    chunk: int,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, KVCacheState, jax.Array]:
+    """One CHUNKED step: each prefilling slot consumes
+    ``min(chunk, prompt_len - pos)`` prompt tokens (a dynamic slice of
+    its prompt buffer), each decoding slot its one carried token; all
+    K/V is appended in place and fp32 logits are returned at each
+    slot's LAST consumed position — the only position whose logits any
+    caller needs (the next-token emission point).
+
+    Returns ``(logits [B, vocab], kv, take [B] int32)`` where ``take``
+    is the per-slot token count consumed (0 for inactive slots) — the
+    same quantity ``Scheduler.next_take`` mirrors on the host.
+    """
+    B = tokens.shape[0]
+    C = int(chunk)
+    n, d, ps = spec.num_heads, spec.head_dim, spec.page_size
+    mp = page_tables.shape[1]
+    W = prompt_buf.shape[1]
+    compute = cfg.compute_dtype
+    eps = cfg.layernorm_epsilon
+
+    pos0 = jnp.where(active, positions, 0).astype(jnp.int32)
+    plen = prompt_lens.astype(jnp.int32)
+    prefilling = pos0 < plen
+    take = jnp.where(
+        active,
+        jnp.where(prefilling, jnp.minimum(C, plen - pos0), 1),
+        0).astype(jnp.int32)
+
+    cols = jnp.arange(C, dtype=jnp.int32)
+    p = pos0[:, None] + cols[None, :]                    # [B, C]
+    valid = cols[None, :] < take[:, None]
+    # chunk token source: the prompt slice while the position is still
+    # inside the prompt, the carried (sampled) token for a decode
+    # slot's column 0; invalid columns are zeroed
+    prompt_tok = jnp.take_along_axis(
+        prompt_buf, jnp.minimum(p, W - 1), axis=1)
+    tok = jnp.where(p < plen[:, None], prompt_tok, tokens[:, None])
+    tok = jnp.where(valid, tok, 0).astype(jnp.int32)
+    pclamp = jnp.where(valid, p, 0)
+
+    word = jnp.take(params["embedding"]["word"], tok, axis=0)
+    posemb = jnp.take(params["embedding"]["position"], pclamp, axis=0)
+    h = (word + posemb).astype(compute)                  # [B, C, hid]
+
+    # per-column write destination; invalid columns land on the
+    # garbage page at offset 0 (read-masked everywhere)
+    page_idx = jnp.take_along_axis(
+        page_tables.astype(jnp.int32),
+        jnp.minimum(pclamp // ps, mp - 1), axis=1)
+    page_idx = jnp.where(valid, page_idx, 0)
+    offsets = jnp.where(valid, pclamp % ps, 0)
+    # causal in-chunk attention: column j sees exactly pos + j + 1
+    # tokens — its own K/V (written below, before attention) and every
+    # predecessor's, in the pool
+    kv_lens = jnp.where(valid, p + 1, 0).astype(jnp.int32)
+    flat_lens = kv_lens.reshape(B * C)
+    pt_rep = jnp.repeat(page_tables, C, axis=0)          # [B*C, mp]
+
+    layers = params["layers"]
+    L = cfg.num_layers
+    scale = 1.0 / (d ** 0.5)
+
+    def layer_body(l, carry):
+        h, pages = carry
+        lp = jax.tree_util.tree_map(lambda a: a[l], layers)
+        dt = h.dtype
+
+        ln1 = _ln(h, lp["input_ln_w"], lp["input_ln_b"], eps).astype(dt)
+        qkv = (jnp.einsum("bch,oh->bco", ln1, lp["qkv_w"].astype(dt))
+               + lp["qkv_b"].astype(dt))                 # [B, C, 3h]
+        qkv = qkv.reshape(B, C, n, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)             # [B, C, n, d]
+
+        pages = write_chunk_kv(pages, l, k, v, page_idx, offsets)
+        ctx = flash_decode(
+            q.reshape(B * C, n, d), pages[l, 0], pages[l, 1],
+            pt_rep, flat_lens, scale=scale,
+            use_kernel=use_kernel, interpret=interpret,
+        ).reshape(B, C, n * d).astype(dt)
+
+        attn = (jnp.einsum("bco,ho->bch", ctx, lp["proj_w"].astype(dt))
+                + lp["proj_b"].astype(dt))
+        h = (h + attn).astype(dt)
+
+        ln2 = _ln(h, lp["post_ln_w"], lp["post_ln_b"], eps).astype(dt)
+        inter = (jnp.einsum("bch,oh->bco", ln2, lp["fc1_w"].astype(dt))
+                 + lp["fc1_b"].astype(dt))
+        inter = jax.nn.gelu(inter, approximate=True)
+        mlp = (jnp.einsum("bco,ho->bch", inter, lp["fc2_w"].astype(dt))
+               + lp["fc2_b"].astype(dt))
+        h = (h + mlp).astype(dt)
+        return (h, pages)
+
+    h, pages = jax.lax.fori_loop(0, L, layer_body, (h, kv.pages))
+
+    # only the LAST consumed column's logits matter (the emission
+    # point); select it before the vocab GEMM — one [B, vocab] head
+    # instead of C of them
+    last = jnp.maximum(take - 1, 0)
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    h_last = _ln(h_last, params["final_ln_w"], params["final_ln_b"],
+                 eps).astype(compute)
+    logits = jnp.einsum(
+        "bh,vh->bv", h_last,
+        params["embedding"]["word"].astype(compute),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, KVCacheState(pages=pages), take
 
 
 def reference_decode(cfg, params, prompt, max_new_tokens: int,

@@ -1,10 +1,25 @@
 """ServingEngine: the continuous-batching decode loop.
 
 One jitted, fixed-shape **unified step** serves every phase: each active
-slot consumes exactly one token per step — a prompt token while
-prefilling, its own last sampled token while decoding — so prefill and
-decode interleave freely inside one program (Orca-style iteration-level
+slot consumes one token per step — a prompt token while prefilling, its
+own last sampled token while decoding — so prefill and decode
+interleave freely inside one program (Orca-style iteration-level
 batching) and a long prompt never stalls other requests' token cadence.
+With ``prefill_chunk=N > 1`` a SECOND fixed-shape program (same carry,
+same donation) ingests up to N prompt tokens per prefilling slot-step;
+boundaries with any prefilling slot run it, pure-decode boundaries keep
+the 1-token program — mixed steps stay one fixed-shape program and the
+decode hot path pays no chunk padding.
+
+The **prefix cache** (on by default; ``prefix_cache=False`` disables)
+lets a request whose prompt head is already resident skip that prefill
+entirely: the scheduler's radix/hash index shares the cached pages
+read-only at admission, the engine applies the pending copy-on-write
+page forks each boundary (``_copy_pool_pages``, donated) before the
+step's K/V writes, admission/probe estimates bill only UNCACHED
+tokens, and :meth:`ServingEngine.swap_params` flushes the cache with
+every weight hot-swap (stale old-weight K/V cannot survive a rolling
+update).
 
 Sync discipline (the serving analogue of the training-step rules the
 PR-4 auditor enforces):
@@ -59,8 +74,12 @@ import numpy as np
 from .. import telemetry
 from ..amp import cast_params_for_inference
 from ..ops.flash_decode import _kernel_ok, flash_decode_available
-from .decode_model import decode_tokens, reference_decode  # noqa: F401
-from .kv_cache import KVCacheState, PagedKVSpec
+from .decode_model import (  # noqa: F401
+    decode_tokens,
+    prefill_chunk_tokens,
+    reference_decode,
+)
+from .kv_cache import KVCacheState, PagedKVSpec, PrefixCache  # noqa: F401
 from .robustness import (
     AdmissionConfig,
     AdmissionController,
@@ -140,6 +159,8 @@ class ServingEngine:
         step_timeout_s: Optional[float] = None,
         chaos=None,
         clock: Optional[Callable[[], float]] = None,
+        prefill_chunk: int = 1,
+        prefix_cache: bool = True,
     ):
         # recovery (recover_from) rebuilds an engine with the same
         # geometry/policies; capture the kwargs before unpacking
@@ -150,7 +171,8 @@ class ServingEngine:
             record_every=record_every, sink=sink, use_kernel=use_kernel,
             interpret=interpret, admission=admission,
             degradation=degradation, watchdog=watchdog,
-            step_timeout_s=step_timeout_s, chaos=chaos, clock=clock)
+            step_timeout_s=step_timeout_s, chaos=chaos, clock=clock,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache)
         self.cfg = cfg
         n, d = cfg.num_attention_heads, cfg.kv_channels
         ps = page_size or default_page_size(n, d)
@@ -186,9 +208,20 @@ class ServingEngine:
                 "<= 256); pass use_kernel=False for the XLA fallback "
                 "or pick a compatible page_size")
         self._chaos = chaos
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        if self.prefill_chunk > self._buf_len:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} exceeds the prompt "
+                f"buffer ({self._buf_len} tokens)")
         self.scheduler = Scheduler(self.spec, self.n_slots,
                                    max_prompt_len=self._buf_len,
-                                   chaos=chaos)
+                                   chaos=chaos,
+                                   prefix_cache=bool(prefix_cache),
+                                   prefill_chunk=self.prefill_chunk)
+        #: the per-engine radix/hash prefix index (None when disabled);
+        #: per-REPLICA in a fleet — each engine's cache is private to
+        #: its own pool and flushed on its own weight swaps
+        self.prefix_cache = self.scheduler.cache
         self.admission = (
             AdmissionController(admission, self.n_slots,
                                 degradation=degradation)
@@ -204,6 +237,12 @@ class ServingEngine:
         self.slots = self._init_slots()
         self.metrics = telemetry.init_metrics()
         self._step = self._build_step()
+        # the chunked-prefill program (built lazily on first use): same
+        # carry, same donation, up to `prefill_chunk` prompt tokens per
+        # prefilling slot; pure-decode boundaries keep using the
+        # 1-token program so the decode hot path pays no chunk padding
+        self._chunk_step = None
+        self._copy_pages = jax.jit(_copy_pool_pages, donate_argnums=(0,))
         self._mutate = jax.jit(_mutate_slots, donate_argnums=(0,))
         self._occupants: List[Optional[int]] = [None] * self.n_slots
         self._no_poison = jnp.zeros((self.n_slots,), bool)
@@ -225,13 +264,21 @@ class ServingEngine:
         fleet's per-replica summary folds."""
         return self._accum
 
-    @staticmethod
-    def _fresh_accum() -> Dict[str, Any]:
+    def _fresh_accum(self) -> Dict[str, Any]:
         return {
             "steps": 0, "active_slot_steps": 0, "prefill_slot_steps": 0,
             "decode_slot_steps": 0, "step_time_s": 0.0,
             "prefill_step_time_s": 0.0, "decode_step_time_s": 0.0,
             "step_times_ms": [], "max_queue_depth": 0,
+            # token-granular split (a chunked prefill slot-step consumes
+            # up to `prefill_chunk` tokens, so slot-steps alone no
+            # longer measure prefill work) + prefix-cache attribution
+            "prefill_tokens": 0, "decode_tokens": 0,
+            "cached_prompt_tokens": 0,
+            # cache counters are engine-lifetime; snapshot them so the
+            # run summary reports THIS run's deltas
+            "cache_base": (self.prefix_cache.stats()
+                           if self.prefix_cache is not None else None),
         }
 
     # -- construction ------------------------------------------------------
@@ -297,6 +344,62 @@ class ServingEngine:
 
         return jax.jit(step, donate_argnums=(1, 2, 5))
 
+    def _build_chunk_step(self):
+        """The chunked-prefill sibling of :meth:`_build_step`: same
+        signature, same donation, same one-emission-per-slot contract —
+        but a prefilling slot consumes up to ``prefill_chunk`` prompt
+        tokens (decode slots ride along consuming their one carried
+        token). Selected by :meth:`run_step` whenever any slot is
+        prefilling; mixed prefill/decode steps therefore stay ONE
+        fixed-shape program."""
+        cfg, spec = self.cfg, self.spec
+        buf_len = self._buf_len
+        chunk = self.prefill_chunk
+        use_kernel, interpret = self._use_kernel, self._interpret
+        tel_every, sink = self.telemetry_every, self.sink
+
+        def step(params, kv, slots, page_tables, poison, metrics):
+            logits, kv, take = prefill_chunk_tokens(
+                cfg, params, spec, kv, slots.tokens, slots.positions,
+                slots.active, slots.prompt_buf, slots.prompt_lens,
+                page_tables, chunk=chunk,
+                use_kernel=use_kernel, interpret=interpret)
+            logits = jnp.where(poison[:, None], jnp.float32(jnp.nan),
+                               logits)
+            bad = slots.active & ~jnp.all(jnp.isfinite(logits), axis=-1)
+            sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            next_pos = slots.positions + take
+            still_prefill = next_pos < slots.prompt_lens
+            prompt_next = jnp.take_along_axis(
+                slots.prompt_buf,
+                jnp.minimum(next_pos, buf_len - 1)[:, None], axis=1)[:, 0]
+            emitted = jnp.where(slots.active & ~still_prefill,
+                                sampled, jnp.int32(NO_TOKEN))
+            emitted = jnp.where(bad, jnp.int32(POISONED), emitted)
+            next_tok = jnp.where(still_prefill, prompt_next, sampled)
+            slots = SlotState(
+                tokens=jnp.where(slots.active, next_tok, slots.tokens),
+                positions=jnp.where(slots.active, next_pos,
+                                    slots.positions),
+                active=slots.active,
+                prompt_buf=slots.prompt_buf,
+                prompt_lens=slots.prompt_lens,
+            )
+            if tel_every > 0:
+                metrics = telemetry.accumulate(
+                    metrics,
+                    tokens=jnp.sum((emitted >= 0).astype(jnp.float32)))
+                metrics = telemetry.drain(
+                    metrics, sink, every_n=tel_every, tag="serving")
+            return kv, slots, emitted, metrics
+
+        return jax.jit(step, donate_argnums=(1, 2, 5))
+
+    def _chunk_step_fn(self):
+        if self._chunk_step is None:
+            self._chunk_step = self._build_chunk_step()
+        return self._chunk_step
+
     # -- audit surface -----------------------------------------------------
     def step_program(self):
         """(jitted step, example args): the surface
@@ -308,15 +411,27 @@ class ServingEngine:
                 telemetry.init_metrics())
         return self._step, args
 
+    def chunk_step_program(self):
+        """(jitted chunked-prefill step, example args) — the second
+        audit surface when ``prefill_chunk > 1``."""
+        fn, args = self.step_program()
+        return self._chunk_step_fn(), args
+
     def audit(self, **kw):
-        """Static audit of the decode step (PR-4 auditor); raises on
-        error-severity findings, returns the report."""
+        """Static audit of the decode step — and, when chunked prefill
+        is enabled, the chunk step too (PR-4 auditor); raises on
+        error-severity findings, returns the (last) report."""
         from ..analysis import assert_step_clean
 
         fn, args = self.step_program()
-        kw.setdefault("name", "serving_decode_step")
         kw.setdefault("pack_specs", [self.spec.pack_spec])
-        return assert_step_clean(fn, *args, **kw)
+        report = assert_step_clean(
+            fn, *args, name=kw.pop("name", "serving_decode_step"), **kw)
+        if self.prefill_chunk > 1:
+            cfn, cargs = self.chunk_step_program()
+            report = assert_step_clean(
+                cfn, *cargs, name="serving_chunk_prefill_step", **kw)
+        return report
 
     # -- request intake ----------------------------------------------------
     def _engine_reject_reason(self, req: Request
@@ -364,8 +479,11 @@ class ServingEngine:
         backlog = queued_tokens + sum(
             max(0, run.total_len() - run.pos)
             for _, run in self.scheduler.running())
-        replay_len = len(req.prompt) + len(req.out_tokens)
-        est_steps = backlog / max(1, self.n_slots) + replay_len
+        # post-hit, post-chunk prefill cost: only the UNCACHED replay
+        # head is actually computed, `prefill_chunk` tokens per step —
+        # the estimate the fleet router's cost model consumes
+        prefill_steps = self._prefill_steps(req)
+        est_steps = backlog / max(1, self.n_slots) + prefill_steps
         if req.status in (RequestStatus.QUEUED, RequestStatus.RUNNING):
             return already_in_flight(req), est_steps
         reason = self._engine_reject_reason(req)
@@ -374,7 +492,8 @@ class ServingEngine:
         if reason is None and self.admission is not None:
             reason = self.admission.probe(
                 req, queue_depth=len(self.scheduler.waiting),
-                queued_tokens=queued_tokens)
+                queued_tokens=queued_tokens,
+                prefill_steps=prefill_steps)
         return reason, est_steps
 
     def try_submit(self, req: Request) -> Optional[RejectionReason]:
@@ -411,7 +530,8 @@ class ServingEngine:
         if reason is None and ctl is not None:
             queued_tokens = self._queued_tokens()
             reason = ctl.check(req, queue_depth=depth,
-                               queued_tokens=queued_tokens)
+                               queued_tokens=queued_tokens,
+                               prefill_steps=self._prefill_steps(req))
         if reason is not None:
             self.sink.record({"event": "reject", "rid": req.rid,
                               "queue_depth": depth,
@@ -462,11 +582,46 @@ class ServingEngine:
                 return True
         return False
 
+    def _uncached_replay(self, req: Request) -> int:
+        """Replay-prompt tokens this engine would actually PREFILL for
+        ``req`` right now: the replay length minus its cached head
+        (capped so the final prompt token is always recomputed — its
+        logits produce the first generated token). An estimate: entries
+        can be evicted before the request admits.
+
+        Memoized per request against the cache's mutation generation —
+        admission walks every queued request on every probe/submit, and
+        between index mutations those walks are identical."""
+        replay = len(req.prompt) + len(req.out_tokens)
+        cache = self.prefix_cache
+        if cache is None or replay < 2:
+            return replay
+        # keyed on the cache IDENTITY too: a fleet router probes every
+        # replica, each with its own cache and generation counter
+        memo = getattr(req, "_uncached_memo", None)
+        probe_key = (id(cache), cache.generation, replay)
+        if memo is not None and memo[0] == probe_key:
+            return memo[1]
+        cached = min(cache.match_len(list(req.prompt)
+                                     + list(req.out_tokens)),
+                     replay - 1)
+        uncached = replay - cached
+        req._uncached_memo = (probe_key, uncached)
+        return uncached
+
+    def _prefill_steps(self, req: Request) -> int:
+        """Engine steps until ``req``'s first token once scheduled:
+        ceil(uncached replay / prefill_chunk)."""
+        return -(-self._uncached_replay(req) // self.prefill_chunk)
+
     def _queued_tokens(self) -> int:
         """Token-budget view of the waiting queue: tokens still to be
-        consumed (replay prompt + remaining generation)."""
+        consumed (UNCACHED replay head + remaining generation — a
+        queued request whose prompt head sits in the prefix cache owes
+        the pool and the step budget only its uncached tail)."""
         return sum(
-            len(r.prompt) + r.max_new_tokens  # out_tokens replay nets out
+            self._uncached_replay(r)
+            + r.max_new_tokens - len(r.out_tokens)
             for r in self.scheduler.waiting)
 
     # -- lifecycle ---------------------------------------------------------
@@ -542,9 +697,13 @@ class ServingEngine:
     # -- the loop ----------------------------------------------------------
     def _sync_device_slots(self) -> None:
         """Push occupancy changes (admissions, evictions, preemptions)
-        to the device slot state as ONE masked update."""
+        — and cursor rewinds (cache-pressure rollback) — to the device
+        slot state as ONE masked update. An admission with a prefix-
+        cache hit starts at its cached cursor: positions and the next
+        token to consume come from ``run.pos``, not 0."""
         sched = self.scheduler
         B, W = self.n_slots, self._buf_len
+        dirty = sched.take_dirty_slots()
         mask = np.zeros((B,), bool)
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -554,15 +713,16 @@ class ServingEngine:
         for i in range(B):
             run = sched.slots[i]
             rid = None if run is None else run.req.rid
-            if rid == self._occupants[i]:
+            if rid == self._occupants[i] and i not in dirty:
                 continue  # unchanged occupancy: device carry is current
             mask[i] = True
             self._occupants[i] = rid
             if run is None:
                 continue  # deactivate row (zeros, active=False)
             plen = len(run.prompt)
-            assert run.pos == 0, "admission must start at position 0"
-            tokens[i] = run.prompt[0]
+            assert run.pos < plen, "admission must start inside the prompt"
+            tokens[i] = run.prompt[run.pos]
+            positions[i] = run.pos
             active[i] = True
             prompt_buf[i, :plen] = np.asarray(run.prompt, np.int32)
             prompt_lens[i] = plen
@@ -616,18 +776,54 @@ class ServingEngine:
         self._enforce_deadlines(now)
         if self.admission is not None:
             self._boundary_degradation(now)
-        sched.admit()
+        if self._chaos is not None and sched.cache is not None:
+            # eviction-under-pressure chaos: force cache evictions at
+            # this boundary (evict_one still refuses reader-held pages
+            # — that is the property under test). getattr: duck-typed
+            # chaos doubles predating the fault stay valid.
+            taker = getattr(self._chaos, "take_cache_evictions", None)
+            for _ in range(taker() if taker is not None else 0):
+                if sched.cache.evict_one() is None:
+                    break
+        admitted = sched.admit()
+        self._accum["cached_prompt_tokens"] += sum(
+            run.cached_tokens for _, run in admitted)
         sched.ensure_capacity()
+        # pressure rollbacks recompute tokens already counted as
+        # cache-skipped: correct the savings accounting
+        self._accum["cached_prompt_tokens"] -= \
+            sched.take_rollback_tokens()
+        forks = sched.take_forks()
+        while forks:
+            # apply the pending COW page copies BEFORE this step's K/V
+            # writes land (padded to a fixed shape so the copy program
+            # compiles once: 0 -> 0 copies the garbage page onto
+            # itself; a write never targets more than one shared page
+            # per slot, so one batch is the common case)
+            batch, forks = forks[:self.n_slots], forks[self.n_slots:]
+            src = np.zeros((self.n_slots,), np.int32)
+            dst = np.zeros((self.n_slots,), np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j], dst[j] = s, d
+            self.kv = self._copy_pages(self.kv, jnp.asarray(src),
+                                       jnp.asarray(dst))
         self._sync_device_slots()
         page_tables = jnp.asarray(sched.page_table_array())
         poison = self._poison_mask(step_no)
         # host classification BEFORE the step (deterministic mirrors):
-        # which slots consume prompt vs generated tokens this step
+        # which slots consume prompt vs generated tokens this step, and
+        # how many tokens each takes (chunked prefill consumes up to
+        # `prefill_chunk` per prefilling slot)
         served = sched.running()
         prefill_slots = [i for i, r in served if r.prefilling]
         decode_slots = [i for i, r in served if not r.prefilling]
+        prefill_tokens = sum(sched.next_take(r)
+                             for _, r in served if r.prefilling)
+        step_fn = (self._chunk_step_fn()
+                   if self.prefill_chunk > 1 and prefill_slots
+                   else self._step)
         t0 = time.perf_counter()
-        self.kv, self.slots, emitted, self.metrics = self._step(
+        self.kv, self.slots, emitted, self.metrics = step_fn(
             self.params, self.kv, self.slots, page_tables, poison,
             self.metrics)
         em = self._fetch_emitted(emitted, step_no)  # the one host sync
@@ -639,7 +835,14 @@ class ServingEngine:
             # feasibility stays meaningful under an injected clock;
             # bench timing (_acct) stays on perf_counter
             self.admission.observe_step(now - boundary_t)
-        sched.advance([i for i, _ in served])
+        # quarantined slots are excluded from advance BEFORE it runs:
+        # advance() publishes freshly completed prompt pages to the
+        # prefix cache, and a slot whose logits went non-finite this
+        # step wrote non-finite K/V this step — publishing it would
+        # hand poisoned pages to every later request sharing the
+        # prefix (cache-hit identity AND fault isolation both break)
+        bad_slots = {i for i, _ in served if int(em[i]) == POISONED}
+        sched.advance([i for i, _ in served if i not in bad_slots])
         for i, run in served:
             tok = int(em[i])
             req = run.req
@@ -668,15 +871,18 @@ class ServingEngine:
                 self._finalize(req, RequestStatus.COMPLETED, "done",
                                now=now)
         self.steps_run += 1
-        self._acct(len(served), len(prefill_slots), len(decode_slots), dt)
+        self._acct(len(served), len(prefill_slots), len(decode_slots),
+                   prefill_tokens, dt)
         return em
 
-    def _acct(self, n_active, n_prefill, n_decode, dt):
+    def _acct(self, n_active, n_prefill, n_decode, n_prefill_tokens, dt):
         a = self._accum
         a["steps"] += 1
         a["active_slot_steps"] += n_active
         a["prefill_slot_steps"] += n_prefill
         a["decode_slot_steps"] += n_decode
+        a["prefill_tokens"] += n_prefill_tokens
+        a["decode_tokens"] += n_decode
         a["step_time_s"] += dt
         a["max_queue_depth"] = max(a["max_queue_depth"],
                                    len(self.scheduler.waiting))
@@ -868,9 +1074,37 @@ class ServingEngine:
             "step_ms": telemetry.percentiles(a["step_times_ms"]),
             "prefill_slot_steps": a["prefill_slot_steps"],
             "decode_slot_steps": a["decode_slot_steps"],
+            # token-granular split: a chunked prefill slot-step ingests
+            # up to `prefill_chunk` tokens, so slot-steps alone no
+            # longer measure prefill work — occupancy and the router's
+            # steps-to-first-token estimate use these instead
+            "prefill_tokens": a["prefill_tokens"],
+            "decode_tokens": a["decode_tokens"],
+            "cached_prompt_tokens": a["cached_prompt_tokens"],
+            "prefill_chunk": self.prefill_chunk,
+            "prefix_cache": self.prefix_cache_run_stats(),
             "prefill_step_time_s": round(a["prefill_step_time_s"], 4),
             "decode_step_time_s": round(a["decode_step_time_s"], 4),
         }
+
+    def prefix_cache_run_stats(self) -> Optional[Dict[str, Any]]:
+        """THIS run's prefix-cache deltas (hits/misses/hit_tokens/
+        insertions/evictions since :meth:`begin_run`) + the live entry
+        count and a request-level hit rate; None when the cache is
+        disabled. The fleet's per-replica summary folds this."""
+        cache = self.prefix_cache
+        if cache is None:
+            return None
+        base = self._accum.get("cache_base") or {}
+        cur = cache.stats()
+        out = {k: cur[k] - base.get(k, 0)
+               for k in ("hits", "misses", "hit_tokens", "insertions",
+                         "evictions")}
+        out["entries"] = cur["entries"]
+        looked = out["hits"] + out["misses"]
+        out["hit_rate"] = round(out["hits"] / looked, 4) if looked else None
+        out["cached_prompt_tokens"] = self._accum["cached_prompt_tokens"]
+        return out
 
     @staticmethod
     def _within_budget(req: Request) -> bool:
@@ -886,6 +1120,21 @@ class ServingEngine:
                 > req.ttft_budget_ms):
             return False
         return True
+
+    # -- weight swap -------------------------------------------------------
+    def swap_params(self, params: Pytree) -> None:
+        """Replace the serving weights in place (through the same
+        one-shot inference cast the ctor runs) AND flush the prefix
+        cache: cached K/V was computed under the OLD weights, so a
+        stale entry surviving a hot swap would serve old-model prefixes
+        under the new model — the fleet's ``try_join`` weight swap goes
+        through here, which is what makes that impossible."""
+        self.params = cast_params_for_inference(params,
+                                                self.cfg.compute_dtype)
+        if self.prefix_cache is not None:
+            flushed = self.prefix_cache.flush()
+            self.sink.record({"event": "prefix_cache_flush",
+                              "entries": flushed})
 
     # -- recovery ----------------------------------------------------------
     @classmethod
@@ -925,6 +1174,17 @@ class ServingEngine:
             "dead_steps_run": dead.steps_run,
         })
         return eng, survivors
+
+
+def _copy_pool_pages(kv: KVCacheState, src: jax.Array,
+                     dst: jax.Array) -> KVCacheState:
+    """COW device half: copy pool pages ``src[i] -> dst[i]`` across
+    every layer's K and V (jitted with the cache donated — an in-place
+    scatter). Padding entries are ``0 -> 0``: the garbage page copied
+    onto itself."""
+    pages = kv.pages
+    pages = pages.at[:, :, dst].set(pages[:, :, src])
+    return KVCacheState(pages=pages)
 
 
 def _mutate_slots(slots: SlotState, mask: jax.Array,

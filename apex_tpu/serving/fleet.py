@@ -74,7 +74,6 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import telemetry
-from ..amp import cast_params_for_inference
 from ..resilience.chaos import ChaosError
 from ..resilience.watchdog import HangError
 from .engine import ServingEngine
@@ -351,8 +350,10 @@ class ReplicaFleet:
         if params is None:
             params = pending
         if params is not None:
-            rep.engine.params = cast_params_for_inference(
-                params, rep.engine.cfg.compute_dtype)
+            # swap_params casts through the inference tables AND
+            # flushes the replica's prefix cache — K/V cached under the
+            # old weights must not survive a rolling update
+            rep.engine.swap_params(params)
             rep.swaps += 1
             self.sink.record({"event": "weight_swap",
                               "replica_id": replica_id,
@@ -713,12 +714,19 @@ class ReplicaFleet:
                    if r.t_first_token is not None
                    and r.t_arrival is not None]
         per_replica = {}
+        fleet_hits = fleet_misses = fleet_hit_tokens = 0
         for rep in self.replicas:
             a = rep.engine.run_accum
             served = [r for r in reqs if r.replica_id == rep.idx]
+            cache_stats = rep.engine.prefix_cache_run_stats()
+            if cache_stats is not None:
+                fleet_hits += cache_stats["hits"]
+                fleet_misses += cache_stats["misses"]
+                fleet_hit_tokens += cache_stats["hit_tokens"]
             per_replica[str(rep.idx)] = {
                 "state": rep.state.value,
                 "steps": a["steps"],
+                "prefix_cache": cache_stats,
                 # per-run deltas, like the fleet-level counters — a
                 # warm fleet's second trace must not report the first
                 # trace's deaths/swaps
@@ -769,5 +777,14 @@ class ReplicaFleet:
             if wall_s > 0 else None,
             "latency_ms": telemetry.percentiles(lat_ms),
             "ttft_ms": telemetry.percentiles(ttft_ms),
+            # fleet-wide prefix-cache view (per-REPLICA caches — a hit
+            # only ever matches pages in the replica's own pool; the
+            # router's post-hit cost estimate is what concentrates
+            # shared-prefix traffic where its pages already live)
+            "prefix_hits": fleet_hits,
+            "prefix_hit_rate": (
+                round(fleet_hits / (fleet_hits + fleet_misses), 4)
+                if (fleet_hits + fleet_misses) else None),
+            "prefix_hit_tokens": fleet_hit_tokens,
             "per_replica": per_replica,
         }

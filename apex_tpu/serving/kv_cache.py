@@ -30,10 +30,32 @@ it, so fixed-shape kernels and scatters always touch valid memory and
 never need per-slot host branching. :class:`PageAllocator` (host-side
 free list) therefore hands out pages ``1..num_pages-1`` and refuses
 double-frees loudly — the invariant the scheduler property tests pin.
+
+Because every page is a fixed-shape chunk whose K/V content is fully
+determined by the token prefix it covers, pages are **content-
+addressable blocks** — the observation the prefix cache builds on
+(vLLM's paged block reuse x SGLang's RadixAttention prefix sharing):
+
+- :class:`PageAllocator` carries per-page **reader refcounts**
+  (``alloc`` = 1, ``share`` pins another reader, ``free`` drops one;
+  the page returns to the free list at zero) plus a separate
+  **cache pin** (``pin``/``unpin`` — the prefix index's own hold), and
+  a copy-on-write ``fork`` bookkeeping primitive;
+- :class:`PrefixCache` is the host-side radix/hash index over those
+  pages: each fully-prefilled page is keyed by the **hash of the token
+  prefix through its last token** (position is implied by the prefix
+  length), so a request whose prompt head matches cached keys shares
+  those pages read-only and skips their prefill entirely. Entries are
+  LRU-ordered; eviction under pool pressure only ever releases entries
+  with **zero readers** — eviction can never free a page a live slot
+  still holds. The device-side copy half of a COW fork lives in the
+  engine (``ServingEngine``); the allocator/cache own the accounting.
 """
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Optional, Sequence
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -173,17 +195,33 @@ def write_token_kv(pages: jax.Array, layer, k: jax.Array, v: jax.Array,
 
 
 class PageAllocator:
-    """Host-side free list over pages ``1..num_pages-1`` (0 reserved).
+    """Host-side free list over pages ``1..num_pages-1`` (0 reserved),
+    with per-page **reader refcounts** and **cache pins**.
 
     LIFO allocation (hot pages stay hot); loud errors on exhaustion
     misuse, double-free, and foreign/reserved frees — the leak/double-
     free invariants the scheduler property tests exercise.
+
+    A live page's lifetime is governed by two independent holds:
+
+    - its *reader refcount* — one per slot holding the page
+      (:meth:`alloc` starts it at 1, :meth:`share` adds a reader,
+      :meth:`free` drops one);
+    - an optional *cache pin* (:meth:`pin`/:meth:`unpin`) — the prefix
+      index's hold, so a cached page outlives the request that
+      prefilled it.
+
+    The page returns to the free list only when BOTH are gone. A page
+    with refcount > 1 or a pin is **shared**: writers must
+    copy-on-write :meth:`fork` it first (the device copy is the
+    engine's half; the allocator swaps the bookkeeping).
     """
 
     def __init__(self, num_pages: int):
         self.num_pages = int(num_pages)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
-        self._used: set = set()
+        self._ref: Dict[int, int] = {}   # live page -> reader refcount
+        self._pinned: Set[int] = set()   # prefix-cache pins
 
     @property
     def free_count(self) -> int:
@@ -191,42 +229,365 @@ class PageAllocator:
 
     @property
     def used_count(self) -> int:
-        return len(self._used)
+        """Pages with at least one READER (a cached page nobody reads
+        is not 'used' — after a drained trace this must be 0 even with
+        a warm prefix cache)."""
+        return sum(1 for r in self._ref.values() if r > 0)
+
+    @property
+    def cached_count(self) -> int:
+        """Pinned pages with zero readers (cache-retained capacity)."""
+        return sum(1 for p in self._pinned if self._ref.get(p, 0) == 0)
+
+    def live_pages(self) -> Dict[int, int]:
+        """``{page: reader refcount}`` for every live page."""
+        return dict(self._ref)
+
+    def refcount(self, p: int) -> int:
+        return self._ref.get(int(p), 0)
+
+    def is_pinned(self, p: int) -> bool:
+        return int(p) in self._pinned
+
+    def is_shared(self, p: int) -> bool:
+        """True when writing into the page would be visible beyond its
+        one owner: more than one reader, or a cache pin (the index
+        promises the page's frozen content to future readers)."""
+        p = int(p)
+        return self._ref.get(p, 0) > 1 or p in self._pinned
 
     def alloc(self) -> Optional[int]:
-        """One page id, or None when exhausted."""
+        """One page id at refcount 1, or None when exhausted."""
         if not self._free:
             return None
         p = self._free.pop()
-        self._used.add(p)
+        self._ref[p] = 1
         return p
 
+    def share(self, p: int) -> None:
+        """Add a reader to a live page (a prefix-cache hit)."""
+        p = int(p)
+        if p not in self._ref:
+            raise ValueError(f"sharing a page that is not live: {p}")
+        self._ref[p] += 1
+
     def free(self, pages: Sequence[int]) -> None:
+        """Drop one reader per page; release to the free list at zero
+        readers (unless cache-pinned)."""
         for p in pages:
             p = int(p)
             if p == PagedKVSpec.GARBAGE_PAGE:
                 raise ValueError("freeing the reserved garbage page 0")
-            if p not in self._used:
+            if self._ref.get(p, 0) < 1:
                 raise ValueError(
                     f"double-free (or foreign free) of page {p}")
-            self._used.remove(p)
+            self._ref[p] -= 1
+            self._maybe_release(p)
+
+    def fork(self, src: int,
+             dst: Optional[int] = None) -> Optional[int]:
+        """Copy-on-write bookkeeping: move the caller's reader hold
+        from shared ``src`` onto a fresh page (``src`` stays live for
+        its other readers / its cache pin). With ``dst=None`` the
+        destination is allocated here (None when the pool is dry — the
+        caller falls back to its pressure machinery); the scheduler's
+        pressure path passes the page it already obtained, so BOTH
+        paths share this one hold-swap primitive. The caller owns the
+        device-side page copy."""
+        if dst is None:
+            dst = self.alloc()
+            if dst is None:
+                return None
+        elif self._ref.get(dst, 0) != 1:
+            raise ValueError(
+                f"fork destination {dst} must be a freshly allocated "
+                "page (exactly one hold)")
+        self.free([src])
+        return dst
+
+    def pin(self, p: int) -> None:
+        """The prefix index's hold on a live page (at most one)."""
+        p = int(p)
+        if p not in self._ref:
+            raise ValueError(f"pinning a page that is not live: {p}")
+        if p in self._pinned:
+            raise ValueError(f"page {p} is already pinned")
+        self._pinned.add(p)
+
+    def unpin(self, p: int) -> None:
+        p = int(p)
+        if p not in self._pinned:
+            raise ValueError(f"unpinning a page that is not pinned: {p}")
+        self._pinned.discard(p)
+        self._maybe_release(p)
+
+    def _maybe_release(self, p: int) -> None:
+        if self._ref.get(p, 0) == 0 and p not in self._pinned:
+            del self._ref[p]
             self._free.append(p)
 
     def check(self) -> None:
-        """Invariant: every non-reserved page is exactly once in
-        free-or-used."""
+        """Invariants: every non-reserved page is exactly once in
+        free-or-live; refcounts never negative; a zero-reader live page
+        must be pinned (else it leaked out of both lists)."""
         free = set(self._free)
         if len(free) != len(self._free):
             raise AssertionError("free list contains duplicates")
-        if free & self._used:
+        live = set(self._ref)
+        if free & live:
             raise AssertionError(
-                f"pages both free and used: {sorted(free & self._used)}")
-        allp = free | self._used
+                f"pages both free and live: {sorted(free & live)}")
+        allp = free | live
         expect = set(range(1, self.num_pages))
         if allp != expect:
             raise AssertionError(
                 f"page accounting leak: missing {sorted(expect - allp)}, "
                 f"unknown {sorted(allp - expect)}")
+        for p, r in self._ref.items():
+            if r < 0:
+                raise AssertionError(f"page {p} refcount {r} < 0")
+            if r == 0 and p not in self._pinned:
+                raise AssertionError(
+                    f"page {p} has zero readers and no pin but was not "
+                    "released")
+        if not self._pinned <= live:
+            raise AssertionError(
+                f"pinned pages not live: {sorted(self._pinned - live)}")
+
+
+def write_chunk_kv(pages: jax.Array, layer, k: jax.Array, v: jax.Array,
+                   page_idx: jax.Array, offsets: jax.Array) -> jax.Array:
+    """Scatter a CHUNK of tokens' K/V per slot into the pool, in place
+    under donation — the chunked-prefill sibling of
+    :func:`write_token_kv`.
+
+    ``pages`` ``[L, 2, P, n, ps, d]``; ``k``/``v`` ``[B, C, n, d]``;
+    ``page_idx``/``offsets`` ``[B, C]`` (invalid chunk columns point at
+    the garbage page, offset 0 — their duplicate writes land on memory
+    nothing ever reads unmasked).
+    """
+    dt = pages.dtype
+    B, C = page_idx.shape
+    pi = page_idx.reshape(B * C)
+    off = offsets.reshape(B * C)
+    k2 = k.reshape((B * C,) + k.shape[2:]).astype(dt)
+    v2 = v.reshape((B * C,) + v.shape[2:]).astype(dt)
+    pages = pages.at[layer, 0, pi, :, off, :].set(k2)
+    pages = pages.at[layer, 1, pi, :, off, :].set(v2)
+    return pages
+
+
+class _CacheEntry:
+    """One indexed page: the pool page id plus the token count of the
+    prefix whose K/V it completes (``n_tokens % page_size`` of them
+    live in this page — a partial tail when not page-aligned)."""
+
+    __slots__ = ("page", "n_tokens")
+
+    def __init__(self, page: int, n_tokens: int):
+        self.page = int(page)
+        self.n_tokens = int(n_tokens)
+
+
+class PrefixCache:
+    """Host-side radix/hash prefix index over the paged KV pool.
+
+    Pages are keyed by ``(prefix length, chained blake2b digest)``
+    where each page's digest hashes the previous page's digest plus
+    its own tokens — so the key commits to every token up to and
+    INCLUDING the page's last one (a page's K/V content depends on the
+    whole prefix through it), while a full walk hashes each token
+    exactly once (the vLLM block-hash chain; a radix tree stores the
+    same relation as explicit edges). Full pages key
+    ``(i+1)*page_size`` tokens; the partial tail of a completed
+    prefill keys the exact prompt length, so only an identical full
+    prompt matches it.
+
+    - :meth:`acquire` walks the chain greedily and **pins a reader
+      refcount** on every matched page (the caller's slot now holds
+      them read-only; its first write into one COW-forks).
+    - :meth:`insert` registers a freshly prefilled page under the
+      index's own :meth:`PageAllocator.pin` — the page outlives its
+      request. Idempotent per key (first publisher wins).
+    - :meth:`evict_one` releases the least-recently-used entry whose
+      page has **zero readers** — under pool pressure the scheduler
+      evicts cache before preempting live work, and eviction can never
+      free a page a live reader holds (reader-held entries are
+      skipped, not unpinned).
+    - :meth:`flush` drops every entry — the weight hot-swap barrier: a
+      cache entry computed under old weights must not survive
+      ``try_join``/restart (``ServingEngine.swap_params`` calls it).
+
+    Deterministic: LRU order is insertion/touch order, no wall clock.
+    """
+
+    def __init__(self, spec: PagedKVSpec, allocator: PageAllocator):
+        self.spec = spec
+        self.allocator = allocator
+        self._entries: "OrderedDict[Tuple[int, bytes], _CacheEntry]" = \
+            OrderedDict()
+        # lifetime counters (engines snapshot them per run)
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.insertions = 0
+        self.evictions = 0
+        #: bumped on every index mutation (insert/evict/flush) — the
+        #: invalidation token for match_len memoization (the engine's
+        #: admission path walks every queued request per probe; a memo
+        #: keyed on this makes repeat walks O(1) between mutations)
+        self.generation = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def page_digest(prev: bytes, page_tokens: Sequence[int]) -> bytes:
+        """One chain step: the digest naming the prefix that ends with
+        ``page_tokens``, given the previous page's digest (``b""``
+        seeds the chain)."""
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(np.asarray(page_tokens, np.int32).tobytes())
+        return h.digest()
+
+    def _chain_keys(self, tokens: Sequence[int]):
+        """Yield ``(end, key)`` per page boundary of ``tokens``, where
+        the key's digest CHAINS: ``digest_i = blake2b(digest_{i-1} ||
+        tokens[i*ps : end_i])`` — the vLLM block-hash scheme. Each
+        token is hashed exactly once, so a full walk (and every
+        admission/router ``match_len``) is O(len), not O(len^2 /
+        page_size); the chain still commits to the whole prefix."""
+        ps = self.spec.page_size
+        arr = np.asarray(tokens, np.int32)
+        prev = b""
+        for start in range(0, len(arr), ps):
+            end = min(start + ps, len(arr))
+            prev = self.page_digest(prev, arr[start:end])
+            yield end, (int(end), prev)
+
+    def _walk(self, tokens: Sequence[int], touch: bool):
+        """Greedy longest-prefix match down the page chain. Returns
+        ``(pages, matched_tokens)`` without refcounting."""
+        pages: List[int] = []
+        matched = 0
+        for end, key in self._chain_keys(tokens):
+            e = self._entries.get(key)
+            if e is None:
+                break
+            if touch:
+                self._entries.move_to_end(key)
+            pages.append(e.page)
+            matched = end
+        return pages, matched
+
+    def match_len(self, tokens: Sequence[int]) -> int:
+        """Read-only: how many head tokens of ``tokens`` the cache
+        covers right now (no pins, no LRU touch) — the admission /
+        router estimate of prefill work actually owed."""
+        _, matched = self._walk(tokens, touch=False)
+        return matched
+
+    def acquire(self, tokens: Sequence[int]):
+        """Longest-prefix hit with reader pins: returns ``(pages,
+        matched_tokens)``; every returned page has had one reader
+        refcount added (:meth:`PageAllocator.share`) — release them
+        through the normal slot-page ``free`` path."""
+        pages, matched = self._walk(tokens, touch=True)
+        if matched:
+            for p in pages:
+                self.allocator.share(p)
+            self.hits += 1
+            self.hit_tokens += matched
+        else:
+            self.misses += 1
+        return pages, matched
+
+    def insert(self, tokens: Sequence[int], page: int) -> bool:
+        """Register ``page`` as holding the K/V that completes the
+        prefix ``tokens``. No-op (False) when the key is already
+        indexed — the first publisher wins, and re-publishing a page a
+        slot itself acquired from the cache must not double-pin.
+
+        Recomputes the chain from token 0 — O(len) per call; the
+        scheduler's publication path avoids that by carrying the
+        running digest per slot and calling :meth:`insert_chained`."""
+        key = None
+        for _, key in self._chain_keys(tokens):
+            pass  # the LAST boundary's key names this page
+        if key is None:
+            raise ValueError("inserting an empty prefix")
+        return self._insert_key(key, page)
+
+    def insert_chained(self, end: int, digest: bytes,
+                       page: int) -> bool:
+        """:meth:`insert` with the chain already walked: ``digest`` is
+        :meth:`page_digest` of this page given its predecessor's —
+        O(page) per published page instead of O(prefix)."""
+        return self._insert_key((int(end), digest), page)
+
+    def _insert_key(self, key: Tuple[int, bytes], page: int) -> bool:
+        if key in self._entries:
+            return False
+        self.allocator.pin(page)
+        self._entries[key] = _CacheEntry(page, key[0])
+        self.insertions += 1
+        self.generation += 1
+        return True
+
+    def evict_one(self) -> Optional[int]:
+        """Release the LRU entry with zero readers; returns the freed
+        page id, or None when every entry is reader-held (nothing can
+        be evicted without yanking a page out from under a live slot —
+        which this method therefore never does)."""
+        for key, e in self._entries.items():
+            if self.allocator.refcount(e.page) == 0:
+                del self._entries[key]
+                self.allocator.unpin(e.page)
+                self.evictions += 1
+                self.generation += 1
+                return e.page
+        return None
+
+    def flush(self) -> int:
+        """Drop EVERY entry (pages with readers stay live until their
+        readers release; zero-reader pages free immediately). The
+        weight hot-swap barrier. Returns the number of entries
+        dropped."""
+        n = len(self._entries)
+        for e in self._entries.values():
+            self.allocator.unpin(e.page)
+        self._entries.clear()
+        if n:
+            self.generation += 1
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "hit_tokens": self.hit_tokens,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "entries": len(self._entries)}
+
+    def check(self) -> None:
+        """Index/allocator coherence: every entry's page is live and
+        pinned; no page is indexed twice; every allocator pin belongs
+        to exactly one entry."""
+        seen: Set[int] = set()
+        for (n_tok, _), e in self._entries.items():
+            if e.page in seen:
+                raise AssertionError(
+                    f"page {e.page} indexed under two keys")
+            seen.add(e.page)
+            if not self.allocator.is_pinned(e.page):
+                raise AssertionError(
+                    f"cache entry ({n_tok} tokens) page {e.page} lost "
+                    "its pin")
+        pinned = {p for p in range(1, self.allocator.num_pages)
+                  if self.allocator.is_pinned(p)}
+        if pinned != seen:
+            raise AssertionError(
+                f"allocator pins {sorted(pinned)} != indexed pages "
+                f"{sorted(seen)}")
 
 
 def page_table_row(spec: PagedKVSpec, pages: Sequence[int]) -> np.ndarray:

@@ -241,7 +241,14 @@ class AdmissionController:
         over ``n_slots`` token-at-a-time slots) plus its own service
         (one step per prompt token to first token, one per new token
         after), at the EWMA step time. ``(None, None)`` when no step
-        has been measured yet."""
+        has been measured yet.
+
+        ``prompt_len`` is really *steps until the first token once
+        scheduled*: with a prefix cache and chunked prefill the engine
+        passes ``ceil(uncached prompt / prefill_chunk)`` — admission
+        feasibility counts only the prefill work actually owed, so a
+        request whose shared head sits in the cache is not refused
+        against flops it will never spend."""
         est = self._est_step_s
         if est <= 0:
             return None, None
@@ -262,11 +269,14 @@ class AdmissionController:
         return self._backpressure
 
     def _admission_reason(self, req: "Request", queue_depth: int,
-                          queued_tokens: int, backpressure: bool
+                          queued_tokens: int, backpressure: bool,
+                          prefill_steps: Optional[int] = None
                           ) -> Optional[RejectionReason]:
         """The admission verdict for one submit, given an (already
         resolved) hysteresis state; ``None`` = admit. Pure — no counter
-        or latch updates."""
+        or latch updates. ``prefill_steps`` overrides the raw prompt
+        length in the feasibility bound (the engine's uncached,
+        chunk-adjusted steps-to-first-token estimate)."""
         if queue_depth >= self.config.max_queue:
             return RejectionReason(
                 RejectionCode.QUEUE_FULL,
@@ -286,7 +296,9 @@ class AdmissionController:
         # estimate) cannot meet its own deadline even if nothing else
         # goes wrong
         ttft_lb, lat_lb = self.latency_bounds_ms(
-            len(req.prompt), req.max_new_tokens, queued_tokens)
+            prefill_steps if prefill_steps is not None
+            else len(req.prompt),
+            req.max_new_tokens, queued_tokens)
         if lat_lb is not None:
             if (req.latency_budget_ms is not None
                     and lat_lb > req.latency_budget_ms):
@@ -317,7 +329,9 @@ class AdmissionController:
         return None
 
     def check(self, req: "Request", *, queue_depth: int,
-              queued_tokens: int) -> Optional[RejectionReason]:
+              queued_tokens: int,
+              prefill_steps: Optional[int] = None
+              ) -> Optional[RejectionReason]:
         """Admission decision for one submit; ``None`` = admit.
         Mutating: latches the watermark hysteresis and counts
         rejections — this is the door a request actually walks
@@ -328,13 +342,16 @@ class AdmissionController:
         if queue_depth < self.config.max_queue:
             self._backpressure = self._next_backpressure(queue_depth)
         reason = self._admission_reason(req, queue_depth, queued_tokens,
-                                        self._backpressure)
+                                        self._backpressure,
+                                        prefill_steps=prefill_steps)
         if reason is not None:
             self.rejected += 1
         return reason
 
     def probe(self, req: "Request", *, queue_depth: int,
-              queued_tokens: int) -> Optional[RejectionReason]:
+              queued_tokens: int,
+              prefill_steps: Optional[int] = None
+              ) -> Optional[RejectionReason]:
         """The verdict :meth:`check` WOULD return for this submit,
         without acting through admission side effects: no hysteresis
         latch flip, no rejection counters, no high-water marks. The
@@ -344,7 +361,9 @@ class AdmissionController:
         return self._admission_reason(
             req, queue_depth, queued_tokens,
             self._next_backpressure(queue_depth)
-            if queue_depth < self.config.max_queue else self._backpressure)
+            if queue_depth < self.config.max_queue
+            else self._backpressure,
+            prefill_steps=prefill_steps)
 
     # -- degradation ---------------------------------------------------------
     @property

@@ -9,29 +9,46 @@ Host-side only. The scheduler owns:
 
 - the waiting queue (FIFO admission into free slots);
 - the page accounting (:class:`~.kv_cache.PageAllocator`): pages are
-  allocated **lazily**, one per slot whenever a request's next token
-  crosses a page boundary, and freed on eviction;
+  allocated **lazily**, enough per slot to cover the tokens it will
+  consume this step (one for decode, up to the prefill chunk for
+  prompt ingestion), and freed on eviction;
+- the **prefix cache** (:class:`~.kv_cache.PrefixCache`, optional): at
+  admission the request's replay prompt is matched against the index
+  and the hit pages are attached read-only — the prefill cursor starts
+  PAST them (capped at ``prompt_len - 1``: the final prompt token is
+  always recomputed, its logits produce the first generated token).
+  Freshly prefilled pages are published back as they fill. A write
+  into a shared page **COW-forks** it first (the engine applies the
+  device-side page copy); under pool pressure, zero-reader cache
+  entries are evicted BEFORE any live request is preempted;
 - **preemption**: when the pool is exhausted, the youngest running
   request is evicted and requeued — its prompt is extended with the
   tokens it already generated, so on re-admission the (deterministic)
   prefill replay rebuilds exactly the cache state it lost. vLLM's
-  recompute-mode preemption;
+  recompute-mode preemption (and the replay's head usually re-hits the
+  pages it just published, so the replay itself is largely free);
 - the per-slot host mirrors (position, prompt, pages, emitted count)
   from which the fixed-shape page-table array is rebuilt each step.
 
 The scheduler never touches device arrays — the engine applies its
-decisions through one gated slot-state update (``serving.engine``).
+decisions through one gated slot-state update plus the pending COW
+page copies (``serving.engine``).
 """
 from __future__ import annotations
 
 import dataclasses
 import itertools
-from collections import deque
-from typing import Deque, List, Optional, Sequence, Tuple
+from collections import Counter, deque
+from typing import Deque, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from .kv_cache import PageAllocator, PagedKVSpec, page_table_row
+from .kv_cache import (
+    PageAllocator,
+    PagedKVSpec,
+    PrefixCache,
+    page_table_row,
+)
 from .robustness import (
     RejectionCode,
     RejectionError,
@@ -78,6 +95,9 @@ class Request:
     failure: Optional[dict] = None
     retries: int = 0
     restarts: int = 0
+    # prefix-cache accounting: prompt tokens skipped at the LAST
+    # admission thanks to a cache hit (TTFT attribution + bench)
+    cached_tokens: int = 0
     # fleet routing: the replica that last admitted this request (None
     # outside fleet serving / before dispatch) — summary attribution
     # and the migration trail both key on it
@@ -105,6 +125,11 @@ class RunningSlot:
     pos: int = 0           # tokens already consumed (= tokens in cache)
     pages: List[int] = dataclasses.field(default_factory=list)
     admit_seq: int = 0     # admission order (victim selection)
+    cached_tokens: int = 0  # prompt head covered by a prefix-cache hit
+    published: int = 0     # pages already offered to the prefix index
+    # memoized chain digests (digests[j] names prompt[:page-j end]) so
+    # publication hashes each token once per slot, not once per page
+    digests: List[bytes] = dataclasses.field(default_factory=list)
 
     @property
     def prefilling(self) -> bool:
@@ -130,18 +155,37 @@ class Scheduler:
     the fault harness steal page allocations: a stolen ``alloc`` looks
     exactly like a dry pool, driving the preemption machinery under
     test without actually shrinking it.
+
+    ``prefix_cache=True`` builds a :class:`~.kv_cache.PrefixCache`
+    over the allocator (``self.cache``); ``prefill_chunk`` is how many
+    prompt tokens a prefilling slot consumes per step (the engine's
+    chunked-prefill knob — the scheduler sizes page allocation and the
+    cursor advance to it).
     """
 
     def __init__(self, spec: PagedKVSpec, n_slots: int,
-                 max_prompt_len: int, chaos=None):
+                 max_prompt_len: int, chaos=None, *,
+                 prefix_cache: bool = False, prefill_chunk: int = 1):
         self.spec = spec
         self.n_slots = int(n_slots)
         self.max_prompt_len = int(max_prompt_len)
+        self.prefill_chunk = max(1, int(prefill_chunk))
         self.allocator = PageAllocator(spec.num_pages)
+        self.cache: Optional[PrefixCache] = (
+            PrefixCache(spec, self.allocator) if prefix_cache else None)
         self.slots: List[Optional[RunningSlot]] = [None] * self.n_slots
         self.waiting: Deque[Request] = deque()
         self._admit_seq = itertools.count()
         self.chaos = chaos
+        # pending COW page copies (src, dst) + slots whose cursor moved
+        # outside the admit/advance lockstep — both drained by the
+        # engine each boundary (take_forks / take_dirty_slots)
+        self._forks: List[Tuple[int, int]] = []
+        self._dirty: Set[int] = set()
+        # cache-hit tokens a pressure rollback un-saved (recomputed
+        # after being counted as skipped) — the engine subtracts them
+        # from its cached_prompt_tokens accounting
+        self._rollback_tokens = 0
 
     # -- queue -------------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -233,7 +277,14 @@ class Scheduler:
     def admit(self) -> List[Tuple[int, RunningSlot]]:
         """Move queued requests into free slots (FIFO). Pages are not
         reserved here — :meth:`ensure_capacity` allocates lazily, and
-        preemption handles a dry pool."""
+        preemption handles a dry pool.
+
+        With a prefix cache, the replay prompt's longest cached head is
+        attached read-only (reader refcounts pinned) and the prefill
+        cursor starts past it — capped at ``len(prompt) - 1`` so the
+        final prompt token is always recomputed: its forward pass
+        produces the first generated token's logits, which no cached
+        page can supply."""
         admitted = []
         for i in range(self.n_slots):
             if self.slots[i] is not None or not self.waiting:
@@ -249,19 +300,86 @@ class Scheduler:
                 # unreachable for submit()-validated requests (replay
                 # growth is bounded at submit); defensive only
                 raise RejectionError(reason)
+            if self.cache is not None:
+                pages, matched = self.cache.acquire(run.prompt)
+                if matched:
+                    run.pages = list(pages)
+                    run.pos = min(matched, len(run.prompt) - 1)
+                    run.cached_tokens = run.pos
+                    run.published = len(pages)
+                # reset on EVERY admission: the field means "skipped at
+                # the LAST admission", and a cache-miss readmission
+                # (e.g. after a hot-swap flush) must not report the
+                # previous admission's savings
+                req.cached_tokens = run.cached_tokens
             req.status = RequestStatus.RUNNING
             self.slots[i] = run
             admitted.append((i, run))
         return admitted
 
     # -- paging ------------------------------------------------------------
-    def _needs_page(self, run: RunningSlot) -> bool:
-        return run.pos // self.spec.page_size >= len(run.pages)
+    def next_take(self, run: RunningSlot) -> int:
+        """Tokens this slot consumes next step: up to ``prefill_chunk``
+        prompt tokens while prefilling, exactly one while decoding.
+        The engine's device step computes the same quantity in-jit —
+        host mirrors and device state advance in lockstep."""
+        if run.prefilling:
+            return min(self.prefill_chunk, len(run.prompt) - run.pos)
+        return 1
+
+    def _fork_index(self, run: RunningSlot, end: int) -> Optional[int]:
+        """The first page index this step's writes touch that is
+        SHARED (read-only: other readers and/or a cache pin) — it must
+        be COW-forked before the device step scatters into it."""
+        if self.cache is None:
+            return None
+        ps = self.spec.page_size
+        first = run.pos // ps
+        last = min((end - 1) // ps, len(run.pages) - 1)
+        for j in range(first, last + 1):
+            if self.allocator.is_shared(run.pages[j]):
+                return j
+        return None
+
+    def _rollback_cached(self, i: int, run: RunningSlot,
+                         from_j: int) -> None:
+        """Pressure fallback when no page can be found for a COW fork:
+        release this slot's hold on pages ``from_j:`` and rewind the
+        prefill cursor to recompute them. The released pages become
+        zero-reader cache entries — exactly what :meth:`evict_one` can
+        now free — so the retry always makes progress, and the
+        deterministic replay keeps token identity."""
+        drop = run.pages[from_j:]
+        if drop:
+            self.allocator.free(drop)
+        run.pages = run.pages[:from_j]
+        new_pos = min(run.pos, from_j * self.spec.page_size)
+        if new_pos != run.pos:
+            run.pos = new_pos
+            self._dirty.add(i)
+        run.published = min(run.published, from_j)
+        del run.digests[from_j:]
+        # tokens counted as cache-skipped that will now be recomputed:
+        # give them back (prefill_tokens_saved must not overstate the
+        # cache win when pressure rollback fires)
+        unsaved = max(0, run.cached_tokens - new_pos)
+        if unsaved:
+            run.cached_tokens -= unsaved
+            run.req.cached_tokens = run.cached_tokens
+            self._rollback_tokens += unsaved
 
     def ensure_capacity(self) -> List[Request]:
-        """Allocate the page each active slot needs for its next token;
-        preempt when the pool runs dry. Returns the preempted, requeued
-        requests.
+        """Give each active slot the pages this step's token writes
+        need — allocating fresh pages, COW-forking shared ones — and
+        preempt when the pool runs dry. Returns the preempted,
+        requeued requests.
+
+        Pressure order: (1) allocate; (2) evict zero-reader prefix-
+        cache entries (cached-but-unread capacity goes first — eviction
+        never touches a page a live reader holds); (3) preempt. A COW
+        fork that still cannot find a page falls back to releasing the
+        shared pages and recomputing them (:meth:`_rollback_cached`)
+        rather than deadlocking or displacing seniors.
 
         Termination contract: seniority (``Request.admit_seq``) is
         stable across preemptions, service is oldest-first, and a
@@ -275,37 +393,110 @@ class Scheduler:
                              key=lambda ir: ir[1].admit_seq):
             if self.slots[i] is not run:
                 continue  # preempted / yielded earlier in this loop
-            while self.slots[i] is run and self._needs_page(run):
-                stolen = (self.chaos is not None
-                          and self.chaos.steal_alloc())
-                page = None if stolen else self.allocator.alloc()
-                if page is not None:
-                    run.pages.append(page)
+            while self.slots[i] is run:
+                end = run.pos + self.next_take(run)
+                fork_j = self._fork_index(run, end)
+                if (fork_j is None
+                        and len(run.pages) >= self.spec.pages_for(end)):
+                    break  # capacity + write-exclusivity satisfied
+                page = self._grab_page(i, run, preempted, fork_j=fork_j)
+                if page is None:
+                    # run yielded its slot (preempted) or rolled its
+                    # cached head back; the while-condition / fresh
+                    # fork scan picks the new state up
                     continue
-                victim = self._pick_victim(exclude=i)
-                if victim is None:
-                    if stolen:
-                        # a chaos-injected transient allocation fault
-                        # with no one to preempt: yield and retry at the
-                        # next boundary (the fault budget is finite)
-                        preempted.append(self._preempt(i))
-                        continue
-                    # unreachable for validated requests (validate()
-                    # refuses pages_for(total) > n_usable_pages), so a
-                    # lone runner always fits; defensive for invariant
-                    # breakage only
-                    raise SchedulerError(
-                        "KV pool too small: one request needs "
-                        f"{self.spec.pages_for(run.total_len())} pages "
-                        f"but the pool has {self.spec.n_usable_pages}")
-                vrun = self.slots[victim]
-                if vrun.admit_seq > run.admit_seq:
-                    preempted.append(self._preempt(victim))
+                if fork_j is not None:
+                    src = run.pages[fork_j]
+                    self._forks.append((src, page))
+                    # the allocator's COW hold-swap, with the page the
+                    # pressure machinery already obtained
+                    run.pages[fork_j] = self.allocator.fork(src, page)
                 else:
-                    # every other runner outranks us: yield our slot
-                    # rather than displace a senior request
-                    preempted.append(self._preempt(i))
+                    run.pages.append(page)
         return preempted
+
+    def _grab_page(self, i: int, run: RunningSlot,
+                   preempted: List[Request], *,
+                   fork_j: Optional[int] = None) -> Optional[int]:
+        """One page under pressure: alloc -> cache eviction ->
+        (rollback | preemption). Returns None when the caller's state
+        changed instead (it yielded its own slot, or rolled back its
+        cached head) — the caller re-evaluates."""
+        while True:
+            stolen = (self.chaos is not None
+                      and self.chaos.steal_alloc())
+            page = None if stolen else self.allocator.alloc()
+            if page is None and not stolen and self.cache is not None:
+                # pool dry: cached-but-unread pages go before any live
+                # request is preempted (evict_one never frees a page a
+                # reader holds)
+                while page is None:
+                    if self.cache.evict_one() is None:
+                        break
+                    page = self.allocator.alloc()
+            if page is not None:
+                return page
+            if fork_j is not None and not stolen and run.prefilling:
+                # a fork target the pool cannot provide, for a slot
+                # still inside its prompt: recompute the shared head
+                # instead of displacing anyone (the rolled-back pages
+                # become evictable, so retries progress). Safe ONLY
+                # while prefilling — a slot that crossed its prompt has
+                # emitted tokens, and rewinding it across the boundary
+                # would re-emit them; decoding slots take the
+                # recompute-preemption requeue below instead, which
+                # folds generated tokens into the replay prompt.
+                self._rollback_cached(i, run, fork_j)
+                return None
+            victim = self._pick_victim(exclude=i)
+            if victim is None:
+                if stolen or fork_j is not None:
+                    # a chaos-injected transient allocation fault — or
+                    # a decode-time COW fork the pool cannot serve —
+                    # with no one to preempt: requeue ourselves and
+                    # retry at the next boundary (the fault budget is
+                    # finite; the replay prompt grows by at least one
+                    # emitted token per fork-preemption cycle, so this
+                    # terminates)
+                    preempted.append(self._preempt(i))
+                    return None
+                # unreachable for validated requests (validate()
+                # refuses pages_for(total) > n_usable_pages and the
+                # cache-eviction pass above frees every unread cached
+                # page), so a lone runner always fits; defensive for
+                # invariant breakage only
+                raise SchedulerError(
+                    "KV pool too small: one request needs "
+                    f"{self.spec.pages_for(run.total_len())} pages "
+                    f"but the pool has {self.spec.n_usable_pages}")
+            vrun = self.slots[victim]
+            if vrun.admit_seq > run.admit_seq:
+                preempted.append(self._preempt(victim))
+                # loop: retry alloc (the victim's exclusive pages are
+                # free now; its cached ones became evictable)
+            else:
+                # every other runner outranks us: yield our slot
+                # rather than displace a senior request
+                preempted.append(self._preempt(i))
+                return None
+
+    def take_forks(self) -> List[Tuple[int, int]]:
+        """Drain the pending COW ``(src, dst)`` page copies — the
+        engine applies them on device BEFORE the step's K/V writes."""
+        out, self._forks = self._forks, []
+        return out
+
+    def take_dirty_slots(self) -> Set[int]:
+        """Slots whose cursor moved outside the admit/advance lockstep
+        (cache-rollback) — the engine must re-push their device rows."""
+        out, self._dirty = self._dirty, set()
+        return out
+
+    def take_rollback_tokens(self) -> int:
+        """Cache-skipped tokens un-saved by pressure rollbacks since
+        the last call (engine accounting correction)."""
+        out, self._rollback_tokens = self._rollback_tokens, 0
+        return out
 
     def _pick_victim(self, exclude: int) -> Optional[int]:
         """The youngest-admitted running request (most recent work is
@@ -333,8 +524,16 @@ class Scheduler:
         if run is None:
             raise SchedulerError(f"freeing empty slot {slot_idx}")
         if run.pages:
+            # a pending COW copy whose destination dies with this slot
+            # must not fire: the freed dst page may be re-allocated to
+            # another slot this same boundary
+            if self._forks:
+                gone = set(run.pages)
+                self._forks = [(s, d) for s, d in self._forks
+                               if d not in gone]
             self.allocator.free(run.pages)
             run.pages = []  # a stale RunningSlot must not look backed
+        self._dirty.discard(slot_idx)
         self.slots[slot_idx] = None
 
     def evict(self, slot_idx: int) -> None:
@@ -352,24 +551,98 @@ class Scheduler:
         return np.stack(rows)
 
     def advance(self, slot_indices: Sequence[int]) -> None:
-        """One token consumed on each given slot."""
+        """Consume this step's tokens on each given slot — one while
+        decoding, up to ``prefill_chunk`` while prefilling (the same
+        :meth:`next_take` the device step computes in-jit) — and
+        publish freshly completed prompt pages to the prefix index."""
         for i in slot_indices:
             run = self.slots[i]
             if run is None:
                 raise SchedulerError(f"advance on empty slot {i}")
-            run.pos += 1
+            was_prefilling = run.prefilling
+            run.pos += self.next_take(run)
+            if self.cache is not None and was_prefilling:
+                self._publish(run)
+
+    def _publish(self, run: RunningSlot) -> None:
+        """Offer newly completed prompt pages to the prefix index:
+        every full page wholly covered by consumed PROMPT tokens, plus
+        — at prefill completion — the partial tail page, keyed by the
+        exact prompt. Idempotent against pages this slot itself
+        acquired from the cache (insertion skips existing keys). The
+        chain digest is memoized per slot (``RunningSlot.digests``),
+        so publishing a whole prompt hashes each token once."""
+        ps = self.spec.page_size
+        plen = len(run.prompt)
+        covered = min(run.pos, plen)
+        while ((run.published + 1) * ps <= covered
+               and run.published < len(run.pages)):
+            j = run.published
+            end = (j + 1) * ps
+            self.cache.insert_chained(
+                end, self._digest_through(run, j, end), run.pages[j])
+            run.published = j + 1
+        if run.pos >= plen and plen % ps:
+            j = plen // ps
+            if run.published == j and j < len(run.pages):
+                self.cache.insert_chained(
+                    plen, self._digest_through(run, j, plen),
+                    run.pages[j])
+                run.published = j + 1
+
+    def _digest_through(self, run: RunningSlot, j: int,
+                        end: int) -> bytes:
+        """The chained digest naming ``run.prompt[:end]`` (page ``j``'s
+        key digest), filling the slot's memo up to ``j`` — O(page) per
+        new page, O(prefix) at most once per admission (when the head
+        was acquired from the cache and the memo starts empty)."""
+        ps = self.spec.page_size
+        while len(run.digests) <= j:
+            k = len(run.digests)
+            k_end = end if k == j else (k + 1) * ps
+            prev = run.digests[k - 1] if k else b""
+            run.digests.append(self.cache.page_digest(
+                prev, run.prompt[k * ps:k_end]))
+        return run.digests[j]
 
     def check_invariants(self) -> None:
-        """Page accounting must balance exactly, and the lifecycle
-        states must match occupancy (tests + chaos harness)."""
+        """Page accounting must balance exactly — now including the
+        prefix-cache refcount cross-checks — and the lifecycle states
+        must match occupancy (tests + chaos harness):
+
+        - every live slot's pages carry refcount >= 1, and each page's
+          reader refcount equals exactly the number of slots holding
+          it (readers; the index pin is accounted separately);
+        - a zero-reader live page must be cache-pinned, and every
+          indexed page is live and pinned exactly once
+          (``PrefixCache.check``);
+        - free pages + live (refcounted) pages + the garbage page
+          cover the pool exactly (``PageAllocator.check``).
+        """
         self.allocator.check()
-        held = [p for _, s in self.running() for p in s.pages]
-        if len(held) != len(set(held)):
-            raise AssertionError("a page is owned by two slots")
-        if set(held) != set(self.allocator._used):
+        holders = Counter(p for _, s in self.running() for p in s.pages)
+        for _, s in self.running():
+            if len(s.pages) != len(set(s.pages)):
+                raise AssertionError(
+                    f"slot holds a page twice: {s.pages}")
+        live = self.allocator.live_pages()
+        for p, cnt in holders.items():
+            if live.get(p, 0) != cnt:
+                raise AssertionError(
+                    f"page {p}: refcount {live.get(p, 0)} != "
+                    f"{cnt} slot holder(s)")
+        for p, rc in live.items():
+            if rc != holders.get(p, 0):
+                raise AssertionError(
+                    f"page {p} has {rc} readers but "
+                    f"{holders.get(p, 0)} slot holder(s)")
+        if (self.allocator.free_count + len(live) + 1
+                != self.spec.num_pages):
             raise AssertionError(
-                f"slot-held pages {sorted(set(held))} != allocator used "
-                f"{sorted(self.allocator._used)}")
+                f"pool accounting: free {self.allocator.free_count} + "
+                f"live {len(live)} + garbage 1 != {self.spec.num_pages}")
+        if self.cache is not None:
+            self.cache.check()
         # lifecycle / occupancy coherence: a terminal request must hold
         # no capacity; queue and slots must carry the matching states
         for req in self.waiting:

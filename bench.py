@@ -735,6 +735,7 @@ def bench_serving():
     prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
     max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
     n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "8"))
     layers = int(os.environ.get(
         "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
     cfg = GPTConfig(
@@ -756,6 +757,7 @@ def bench_serving():
         for i in range(n_req)
     ]
     eng = ServingEngine(cfg, params, n_slots=n_slots,
+                        prefill_chunk=chunk,
                         telemetry_every=8, sink=telemetry_recorder())
     eng.generate(reqs)
     st = eng.last_stats
@@ -779,6 +781,8 @@ def bench_serving():
         "layers": layers,
         "page_size": eng.spec.page_size,
         "kv_pool_mb": round(eng.spec.cache_bytes() / 2**20, 1),
+        "prefill_chunk": st["prefill_chunk"],
+        "prefix_hit_rate": (st["prefix_cache"] or {}).get("hit_rate"),
     }
     tot = st["prefill_slot_steps"] + st["decode_slot_steps"]
     prefill_decode_split = {
@@ -786,6 +790,12 @@ def bench_serving():
         "decode_slot_steps": st["decode_slot_steps"],
         "prefill_frac": round(st["prefill_slot_steps"] / tot, 4)
         if tot else None,
+        # token-granular split (a chunked prefill slot-step ingests up
+        # to prefill_chunk tokens — slot-steps alone no longer measure
+        # prefill work)
+        "prefill_tokens": st["prefill_tokens"],
+        "decode_tokens": st["decode_tokens"],
+        "cached_prompt_tokens": st["cached_prompt_tokens"],
         "prefill_step_time_s": st["prefill_step_time_s"],
         "decode_step_time_s": st["decode_step_time_s"],
     }
@@ -926,6 +936,7 @@ def bench_serving_fleet():
     n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
     load = float(os.environ.get("BENCH_FLEET_LOAD", "0.8"))
     n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "24"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "8"))
     prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
     max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
     n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
@@ -980,7 +991,7 @@ def bench_serving_fleet():
         sink=telemetry_recorder(),
         migration_retry=RetryPolicy(attempts=10_000,
                                     deadline=budget_ms / 1e3),
-        n_slots=n_slots,
+        n_slots=n_slots, prefill_chunk=chunk,
         admission=AdmissionConfig(max_queue=4 * n_slots,
                                   high_watermark=0.75,
                                   low_watermark=0.375),
@@ -1013,11 +1024,131 @@ def bench_serving_fleet():
         "ttft_budget_ms": round(ttft_ms, 1),
         "steps": st["steps"],
         "page_leaks": fleet.page_leaks(),
+        "prefill_chunk": chunk,
+        "prefix_hit_rate": st["prefix_hit_rate"],
+        "prefix_hit_tokens": st["prefix_hit_tokens"],
         "per_replica": st["per_replica"],
         "slots": n_slots,
         "prompt_len_max": prompt_len,
         "prompt_len_mean": round(sum(plens) / len(plens), 1),
         "max_new_tokens": max_new,
+        "layers": layers,
+    }}
+
+
+def bench_prefix_reuse():
+    """``prefix_reuse`` leg: the amortize-the-fleet's-shared-context
+    measurement (ISSUE-12) — a Zipfian shared-prefix trace (a FEW
+    system prompts carry most of the traffic, each request = shared
+    long head + short unique suffix: the shape of serving millions of
+    users) run twice on the same engine config:
+
+    - COLD: prefix cache disabled — every request prefills its whole
+      prompt (chunked, so the comparison isolates the CACHE win);
+    - WARM: prefix cache enabled — the first request per system prompt
+      prefills and publishes it, every later request sharing that head
+      skips its prefill entirely (radix/hash hit on the paged pool).
+
+    Reported: TTFT p50/p99 for both passes and the reduction, the
+    request-level cache hit rate, prefill tokens/flops saved (flops at
+    the standard 24*L*h^2 per-token forward estimate), and zero page
+    leaks. ``compare_bench`` regression-tracks warm TTFT p99, hit
+    rate, and flops saved like the other serving legs.
+    """
+    import numpy as _np
+
+    from apex_tpu.serving import Request, ServingEngine
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    n_req = int(os.environ.get("BENCH_PREFIX_REQUESTS", "16"))
+    n_sys = int(os.environ.get("BENCH_PREFIX_SYSPROMPTS", "3"))
+    head_len = int(os.environ.get(
+        "BENCH_PREFIX_HEAD", os.environ.get("BENCH_SERVING_PROMPT",
+                                            "128")))
+    suffix_len = int(os.environ.get("BENCH_PREFIX_SUFFIX", "16"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    prompt_cap = head_len + suffix_len
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_cap + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    rng = _np.random.default_rng(0)
+    heads = [[int(t) for t in
+              rng.integers(0, cfg.vocab_size, size=head_len)]
+             for _ in range(n_sys)]
+    # Zipfian head choice: rank-1 mass dominates (the one system prompt
+    # most of the fleet's traffic shares)
+    picks = [min(int(rng.zipf(1.3)) - 1, n_sys - 1) for _ in range(n_req)]
+    suffixes = [[int(t) for t in
+                 rng.integers(0, cfg.vocab_size, size=suffix_len)]
+                for _ in range(n_req)]
+    arrivals = [int(i * max(1, max_new // 2) // max(1, n_slots))
+                for i in range(n_req)]
+
+    def mk_trace():
+        return [Request(prompt=heads[picks[i]] + suffixes[i],
+                        max_new_tokens=max_new,
+                        arrival_step=arrivals[i])
+                for i in range(n_req)]
+
+    def run(prefix_cache):
+        eng = ServingEngine(cfg, params, n_slots=n_slots,
+                            prefill_chunk=chunk,
+                            prefix_cache=prefix_cache,
+                            telemetry_every=8,
+                            sink=telemetry_recorder())
+        eng.generate(mk_trace())
+        eng.scheduler.check_invariants()
+        return eng
+
+    cold = run(False)
+    warm = run(True)
+    st_c, st_w = cold.last_stats, warm.last_stats
+    cache = st_w["prefix_cache"]
+    saved_tokens = st_w["cached_prompt_tokens"]
+    # standard dense-transformer forward estimate: 2 flops/MAC x 12 h^2
+    # MACs per layer per token (attention-length terms excluded — this
+    # is the GEMM bill the cache actually skips)
+    flops_per_token = 24 * layers * cfg.hidden_size ** 2
+    prompt_tokens = sum(len(heads[picks[i]]) + suffix_len
+                        for i in range(n_req))
+    ttft_c, ttft_w = st_c["ttft_ms"], st_w["ttft_ms"]
+    red = None
+    if ttft_c.get("p50") and ttft_w.get("p50"):
+        red = round(100.0 * (ttft_c["p50"] - ttft_w["p50"])
+                    / ttft_c["p50"], 2)
+    return {"prefix_reuse": {
+        "n_requests": n_req,
+        "n_system_prompts": n_sys,
+        "head_len": head_len,
+        "suffix_len": suffix_len,
+        "prefill_chunk": chunk,
+        "zipf_picks": picks,
+        "hit_rate": cache["hit_rate"],
+        "hits": cache["hits"],
+        "hit_tokens": cache["hit_tokens"],
+        "evictions": cache["evictions"],
+        "prefill_tokens_saved": saved_tokens,
+        "prefill_tokens_saved_frac": round(
+            saved_tokens / prompt_tokens, 4) if prompt_tokens else None,
+        "prefill_flops_saved": saved_tokens * flops_per_token,
+        "ttft_p50_ms": ttft_w.get("p50"),
+        "ttft_p99_ms": ttft_w.get("p99"),
+        "ttft_cold_p50_ms": ttft_c.get("p50"),
+        "ttft_cold_p99_ms": ttft_c.get("p99"),
+        "ttft_reduction_pct": red,
+        "tokens_per_sec": st_w["tokens_per_sec"],
+        "steps": st_w["steps"],
+        "steps_cold": st_c["steps"],
+        "page_leaks": warm.scheduler.allocator.used_count,
+        "slots": n_slots,
         "layers": layers,
     }}
 
@@ -1486,6 +1617,22 @@ def main() -> None:
             print(f"serving fleet bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # prefix-reuse leg: the Zipfian shared-prefix trace measuring what
+    # the radix/hash prefix cache + chunked prefill buy — warm-vs-cold
+    # TTFT, hit rate, prefill flops saved (ISSUE-12). Gated like the
+    # serving legs (BENCH_PREFIX_REUSE overrides).
+    prefix_reuse = None
+    want_prefix = os.environ.get("BENCH_PREFIX_REUSE", want_serving)
+    if want_prefix != "0" and (not fast or want_prefix == "1"):
+        try:
+            prefix_reuse = _retry_transient(
+                bench_prefix_reuse, tag="prefix reuse leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"prefix reuse bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -1556,6 +1703,7 @@ def main() -> None:
         "prefill_decode_split": (serving or {}).get("prefill_decode_split"),
         "serving_overload": (serving_overload or {}).get("serving_overload"),
         "serving_fleet": (serving_fleet or {}).get("serving_fleet"),
+        "prefix_reuse": (prefix_reuse or {}).get("prefix_reuse"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

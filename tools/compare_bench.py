@@ -48,6 +48,9 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("serving_occupancy", "serving_throughput.occupancy", True),
     ("serving_goodput", "serving_overload.goodput_tokens_per_sec", True),
     ("serving_slo_attainment", "serving_overload.slo_attainment", True),
+    ("prefix_ttft_p99_ms", "prefix_reuse.ttft_p99_ms", False),
+    ("prefix_hit_rate", "prefix_reuse.hit_rate", True),
+    ("prefix_flops_saved", "prefix_reuse.prefill_flops_saved", True),
     ("serving_overload_ttft_p99_ms", "serving_overload.ttft_p99_ms", False),
     ("fleet_slo_attainment", "serving_fleet.slo_attainment", True),
     ("fleet_goodput", "serving_fleet.goodput_tokens_per_sec", True),

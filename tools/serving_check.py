@@ -14,6 +14,23 @@ tier-1 CPU lane:
                       per-request dense-attention greedy decode loop
                       (``serving.reference_decode`` — the full training
                       forward recomputed per token).
+
+Prefix-cache / chunked-prefill legs (ISSUE-12 — the token-identity
+oracle extended verbatim):
+
+- ``chunked_prefill_identity``  the SAME staggered trace run at
+                      several ``prefill_chunk`` sizes (including a
+                      chunk larger than any prompt) emits exactly the
+                      token-at-a-time engine's tokens — chunked prompt
+                      ingestion changes step count, never content.
+- ``prefix_hit_identity``  requests sharing prompt heads (and one
+                      exact-duplicate prompt) run twice on one engine:
+                      the warm pass MUST hit the radix/hash prefix
+                      cache (skipping that prefill work) and both
+                      passes MUST be byte-identical to the cold dense
+                      reference; the duplicate's first decode write
+                      exercises the COW fork; zero reader-held pages
+                      remain.
 - ``step_audit``      the jitted decode step passes the PR-4 static
                       auditor clean: KV cache / slot state / metrics
                       donated, no ungated callbacks, PackSpec layout
@@ -160,6 +177,88 @@ def check_token_identity() -> dict:
             "steps": eng.last_stats["steps"],
             "occupancy": eng.last_stats["occupancy"],
             "preemptions": eng.last_stats["preemptions"]}
+
+
+def check_chunked_prefill_identity() -> dict:
+    import numpy as np
+
+    from apex_tpu.serving import Request, ServingEngine, reference_decode
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+
+    def mk():
+        rng = np.random.default_rng(7)
+        return [
+            Request(prompt=list(rng.integers(0, cfg.vocab_size, size=L)),
+                    max_new_tokens=8, arrival_step=2 * i)
+            for i, L in enumerate((14, 11, 13, 9))
+        ]
+
+    refs = {i: reference_decode(cfg, params, r.prompt, r.max_new_tokens)
+            for i, r in enumerate(mk())}
+    mismatches, steps = [], {}
+    for chunk in (1, 3, 8, 16):
+        reqs = mk()
+        # tiny pool: the chunked path must survive real continuous
+        # batching (shared slots, preemption) too, not just ingestion
+        eng = ServingEngine(cfg, params, n_slots=2, num_pages=5,
+                            max_prompt_len=16, prefill_chunk=chunk)
+        out = eng.generate(reqs, max_steps=2000)
+        eng.scheduler.check_invariants()
+        steps[chunk] = eng.last_stats["steps"]
+        for i, r in enumerate(reqs):
+            if out[r.rid] != refs[i]:
+                mismatches.append({"chunk": chunk, "req": i,
+                                   "engine": out[r.rid],
+                                   "reference": refs[i]})
+        if eng.scheduler.allocator.used_count != 0:
+            mismatches.append({"chunk": chunk, "page_leaks":
+                               eng.scheduler.allocator.used_count})
+    # chunked ingestion must actually shorten the trace
+    speedup_ok = steps[8] < steps[1]
+    ok = not mismatches and speedup_ok
+    return {"ok": ok, "mismatches": mismatches, "steps_by_chunk": steps,
+            "chunked_fewer_steps": speedup_ok}
+
+
+def check_prefix_hit_identity() -> dict:
+    import numpy as np
+
+    from apex_tpu.serving import Request, ServingEngine, reference_decode
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    rng = np.random.default_rng(11)
+    head = list(rng.integers(0, cfg.vocab_size, size=32))
+    prompts = [
+        head[:32] + list(rng.integers(0, cfg.vocab_size, size=6)),
+        head[:32] + list(rng.integers(0, cfg.vocab_size, size=4)),
+        list(head[:32]),   # page-aligned full-prompt duplicate (COW)
+    ]
+    eng = ServingEngine(cfg, params, n_slots=2, num_pages=24,
+                        prefill_chunk=4)
+    cold = [Request(prompt=list(p), max_new_tokens=6) for p in prompts]
+    out_cold = eng.generate(cold, max_steps=2000)
+    warm = [Request(prompt=list(p), max_new_tokens=6) for p in prompts]
+    out_warm = eng.generate(warm, max_steps=2000)
+    eng.scheduler.check_invariants()
+    st = eng.last_stats["prefix_cache"]
+    mismatches = []
+    for p, c, w in zip(prompts, cold, warm):
+        ref = reference_decode(cfg, params, p, 6)
+        if out_cold[c.rid] != ref:
+            mismatches.append({"pass": "cold", "engine": out_cold[c.rid],
+                               "reference": ref})
+        if out_warm[w.rid] != ref:
+            mismatches.append({"pass": "warm", "engine": out_warm[w.rid],
+                               "reference": ref})
+    ok = (not mismatches
+          and st["hits"] == len(prompts)           # every warm prompt hit
+          and st["hit_tokens"] >= 3 * 32           # at least the heads
+          and eng.scheduler.allocator.used_count == 0)
+    return {"ok": ok, "mismatches": mismatches, "prefix_cache": st,
+            "page_leaks": eng.scheduler.allocator.used_count}
 
 
 def check_step_audit() -> dict:
@@ -414,6 +513,8 @@ def check_fleet_drain_join() -> dict:
 
 CHECKS = {
     "decode_parity": check_decode_parity,
+    "chunked_prefill_identity": check_chunked_prefill_identity,
+    "prefix_hit_identity": check_prefix_hit_identity,
     "fleet_kill_migrate": check_fleet_kill_migrate,
     "fleet_drain_join": check_fleet_drain_join,
     "token_identity": check_token_identity,
