@@ -53,9 +53,20 @@ from .engine import (  # noqa: F401
     default_page_size,
 )
 from .decode_model import (  # noqa: F401
+    chunk_hidden,
     decode_tokens,
     prefill_chunk_tokens,
     reference_decode,
+    reference_sample_decode,
+)
+from .sampling import (  # noqa: F401
+    GREEDY,
+    SamplingParams,
+    sample_tokens,
+)
+from .spec_decode import (  # noqa: F401
+    ngram_propose,
+    run_spec_step,
 )
 from .fleet import (  # noqa: F401
     Replica,
@@ -96,6 +107,8 @@ __all__ = [
     "AdmissionConfig",
     "AdmissionController",
     "DegradationPolicy",
+    "GREEDY",
+    "SamplingParams",
     "KVCacheState",
     "NO_TOKEN",
     "POISONED",
@@ -118,13 +131,18 @@ __all__ = [
     "TERMINAL_STATES",
     "TransientRequestFailure",
     "VirtualClock",
+    "chunk_hidden",
     "decode_tokens",
     "default_page_size",
     "is_terminal",
+    "ngram_propose",
     "page_table_row",
     "prefill_chunk_tokens",
     "recover_requests",
     "reference_decode",
+    "reference_sample_decode",
+    "run_spec_step",
+    "sample_tokens",
     "write_chunk_kv",
     "write_token_kv",
 ]

@@ -152,60 +152,32 @@ def decode_tokens(
     return logits, KVCacheState(pages=pages)
 
 
-def prefill_chunk_tokens(
+def chunk_hidden(
     cfg,
     params: Pytree,
     spec: PagedKVSpec,
     kv: KVCacheState,
-    tokens: jax.Array,       # [B] int32 — decode slots' carried token
-    positions: jax.Array,    # [B] int32 — tokens already cached
-    active: jax.Array,       # [B] bool
-    prompt_buf: jax.Array,   # [B, W] int32 — replay prompt text
-    prompt_lens: jax.Array,  # [B] int32
+    tok: jax.Array,          # [B, C] int32 — per-column tokens (0 pad)
+    pclamp: jax.Array,       # [B, C] int32 — positions (0 for invalid)
+    valid: jax.Array,        # [B, C] bool — consumed-column mask
     page_tables: jax.Array,  # [B, pages_per_seq] int32
     *,
-    chunk: int,
     use_kernel: Optional[bool] = None,
     interpret: bool = False,
-) -> Tuple[jax.Array, KVCacheState, jax.Array]:
-    """One CHUNKED step: each prefilling slot consumes
-    ``min(chunk, prompt_len - pos)`` prompt tokens (a dynamic slice of
-    its prompt buffer), each decoding slot its one carried token; all
-    K/V is appended in place and fp32 logits are returned at each
-    slot's LAST consumed position — the only position whose logits any
-    caller needs (the next-token emission point).
-
-    Returns ``(logits [B, vocab], kv, take [B] int32)`` where ``take``
-    is the per-slot token count consumed (0 for inactive slots) — the
-    same quantity ``Scheduler.next_take`` mirrors on the host.
-    """
-    B = tokens.shape[0]
-    C = int(chunk)
+) -> Tuple[jax.Array, jax.Array]:
+    """The chunk-shaped transformer body shared by chunked prefill and
+    speculative verification: embed a ``[B, C]`` token grid, scatter
+    each valid column's K/V into the pool BEFORE attention, and attend
+    with per-column ``kv_lens = pos + 1`` — so the ``[B, C]`` chunk
+    flattens into a ``[B*C]`` single-query ``flash_decode`` batch and
+    in-chunk attention is causal by construction. Returns the final
+    hidden states ``[B, C, hidden]`` (pre final-LN) and the updated
+    page pool."""
+    B, C = tok.shape
     n, d, ps = spec.num_heads, spec.head_dim, spec.page_size
     mp = page_tables.shape[1]
-    W = prompt_buf.shape[1]
     compute = cfg.compute_dtype
     eps = cfg.layernorm_epsilon
-
-    pos0 = jnp.where(active, positions, 0).astype(jnp.int32)
-    plen = prompt_lens.astype(jnp.int32)
-    prefilling = pos0 < plen
-    take = jnp.where(
-        active,
-        jnp.where(prefilling, jnp.minimum(C, plen - pos0), 1),
-        0).astype(jnp.int32)
-
-    cols = jnp.arange(C, dtype=jnp.int32)
-    p = pos0[:, None] + cols[None, :]                    # [B, C]
-    valid = cols[None, :] < take[:, None]
-    # chunk token source: the prompt slice while the position is still
-    # inside the prompt, the carried (sampled) token for a decode
-    # slot's column 0; invalid columns are zeroed
-    prompt_tok = jnp.take_along_axis(
-        prompt_buf, jnp.minimum(p, W - 1), axis=1)
-    tok = jnp.where(p < plen[:, None], prompt_tok, tokens[:, None])
-    tok = jnp.where(valid, tok, 0).astype(jnp.int32)
-    pclamp = jnp.where(valid, p, 0)
 
     word = jnp.take(params["embedding"]["word"], tok, axis=0)
     posemb = jnp.take(params["embedding"]["position"], pclamp, axis=0)
@@ -221,7 +193,7 @@ def prefill_chunk_tokens(
     # causal in-chunk attention: column j sees exactly pos + j + 1
     # tokens — its own K/V (written below, before attention) and every
     # predecessor's, in the pool
-    kv_lens = jnp.where(valid, p + 1, 0).astype(jnp.int32)
+    kv_lens = jnp.where(valid, pclamp + 1, 0).astype(jnp.int32)
     flat_lens = kv_lens.reshape(B * C)
     pt_rep = jnp.repeat(page_tables, C, axis=0)          # [B*C, mp]
 
@@ -261,19 +233,84 @@ def prefill_chunk_tokens(
         return (h, pages)
 
     h, pages = jax.lax.fori_loop(0, L, layer_body, (h, kv.pages))
+    return h, pages
+
+
+def lm_logits(cfg, params: Pytree, h: jax.Array) -> jax.Array:
+    """Final LN + tied-embedding head, fp32 logits (training
+    ``_lm_head`` parity). ``h`` is ``[..., hidden]``; the vocab GEMM
+    runs over whatever leading shape the caller kept."""
+    compute = cfg.compute_dtype
+    h = _ln(h, params["final_ln_w"], params["final_ln_b"],
+            cfg.layernorm_epsilon).astype(compute)
+    return jnp.einsum(
+        "...h,vh->...v", h,
+        params["embedding"]["word"].astype(compute),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def prefill_chunk_tokens(
+    cfg,
+    params: Pytree,
+    spec: PagedKVSpec,
+    kv: KVCacheState,
+    tokens: jax.Array,       # [B] int32 — decode slots' carried token
+    positions: jax.Array,    # [B] int32 — tokens already cached
+    active: jax.Array,       # [B] bool
+    prompt_buf: jax.Array,   # [B, W] int32 — replay prompt text
+    prompt_lens: jax.Array,  # [B] int32
+    page_tables: jax.Array,  # [B, pages_per_seq] int32
+    *,
+    chunk: int,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, KVCacheState, jax.Array]:
+    """One CHUNKED step: each prefilling slot consumes
+    ``min(chunk, prompt_len - pos)`` prompt tokens (a dynamic slice of
+    its prompt buffer), each decoding slot its one carried token; all
+    K/V is appended in place and fp32 logits are returned at each
+    slot's LAST consumed position — the only position whose logits any
+    caller needs (the next-token emission point).
+
+    Returns ``(logits [B, vocab], kv, take [B] int32)`` where ``take``
+    is the per-slot token count consumed (0 for inactive slots) — the
+    same quantity ``Scheduler.next_take`` mirrors on the host.
+    """
+    B = tokens.shape[0]
+    C = int(chunk)
+    W = prompt_buf.shape[1]
+
+    pos0 = jnp.where(active, positions, 0).astype(jnp.int32)
+    plen = prompt_lens.astype(jnp.int32)
+    prefilling = pos0 < plen
+    take = jnp.where(
+        active,
+        jnp.where(prefilling, jnp.minimum(C, plen - pos0), 1),
+        0).astype(jnp.int32)
+
+    cols = jnp.arange(C, dtype=jnp.int32)
+    p = pos0[:, None] + cols[None, :]                    # [B, C]
+    valid = cols[None, :] < take[:, None]
+    # chunk token source: the prompt slice while the position is still
+    # inside the prompt, the carried (sampled) token for a decode
+    # slot's column 0; invalid columns are zeroed
+    prompt_tok = jnp.take_along_axis(
+        prompt_buf, jnp.minimum(p, W - 1), axis=1)
+    tok = jnp.where(p < plen[:, None], prompt_tok, tokens[:, None])
+    tok = jnp.where(valid, tok, 0).astype(jnp.int32)
+    pclamp = jnp.where(valid, p, 0)
+
+    h, pages = chunk_hidden(cfg, params, spec, kv, tok, pclamp, valid,
+                            page_tables, use_kernel=use_kernel,
+                            interpret=interpret)
 
     # only the LAST consumed column's logits matter (the emission
     # point); select it before the vocab GEMM — one [B, vocab] head
     # instead of C of them
     last = jnp.maximum(take - 1, 0)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
-    h_last = _ln(h_last, params["final_ln_w"], params["final_ln_b"],
-                 eps).astype(compute)
-    logits = jnp.einsum(
-        "bh,vh->bv", h_last,
-        params["embedding"]["word"].astype(compute),
-        preferred_element_type=jnp.float32,
-    )
+    logits = lm_logits(cfg, params, h_last)
     return logits, KVCacheState(pages=pages), take
 
 
@@ -296,6 +333,50 @@ def reference_decode(cfg, params, prompt, max_new_tokens: int,
             cfg, params, jnp.asarray([toks], jnp.int32),
             deterministic=True)
         nxt = int(jnp.argmax(logits[0, -1].astype(jnp.float32)))
+        out.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+        toks.append(nxt)
+    return out
+
+
+def reference_sample_decode(cfg, params, prompt, max_new_tokens: int,
+                            *, sampling=None, rid: int = 0,
+                            eos_id: Optional[int] = None):
+    """Per-request dense-attention SAMPLED decode — the seeded oracle.
+
+    The non-greedy twin of :func:`reference_decode`: the full training
+    forward recomputed per emitted token, with the next token drawn by
+    the SAME :func:`~apex_tpu.serving.sampling.sample_tokens` the
+    engine's jitted step runs, keyed by the same ``(seed, rid,
+    position)`` hash counter — so engine-vs-reference byte identity
+    extends from greedy to temperature/top-k/top-p decode, and (because
+    the draw at a position is a pure function of the position) survives
+    preemption replay, engine recovery, fleet migration AND speculative
+    verification unchanged. ``sampling=None`` (or ``temperature == 0``)
+    is exactly :func:`reference_decode`'s greedy loop.
+    """
+    from ..transformer.testing.standalone_transformer_lm import gpt_forward
+    from .sampling import i32_wrap, resolve, sample_tokens
+
+    sp = resolve(sampling)
+    rid = i32_wrap(rid)
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(int(max_new_tokens)):
+        logits = gpt_forward(
+            cfg, params, jnp.asarray([toks], jnp.int32),
+            deterministic=True)
+        nxt = int(sample_tokens(
+            logits[0, -1:].astype(jnp.float32).reshape(1, -1),
+            jnp.asarray([sp.temperature], jnp.float32),
+            jnp.asarray([sp.top_k], jnp.int32),
+            jnp.asarray([sp.top_p], jnp.float32),
+            jnp.asarray([i32_wrap(sp.seed)], jnp.int32),
+            jnp.asarray([rid], jnp.int32),
+            # the sampled token OCCUPIES position len(toks) — the PRNG
+            # counter the engine keys the same draw with
+            jnp.asarray([len(toks)], jnp.int32))[0])
         out.append(nxt)
         if eos_id is not None and nxt == eos_id:
             break

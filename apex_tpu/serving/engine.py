@@ -94,24 +94,42 @@ from .robustness import (
     recover_requests,
     request_expired,
 )
+from .sampling import i32_wrap as _i32_wrap
+from .sampling import resolve, sample_tokens
 from .scheduler import Request, Scheduler, SchedulerError
+from .spec_decode import (  # noqa: F401  (sentinel re-export: the
+    NO_TOKEN,  # fetched array carries tokens AND the per-slot fault
+    POISONED,  # flag, so fault isolation adds no second host sync)
+    run_spec_step,
+)
 
 Pytree = Any
 
-#: emitted-token sentinels (the one fetched vector carries tokens AND
-#: the per-slot fault flag, so fault isolation adds no second host sync)
-NO_TOKEN = -1
-POISONED = -2
-
 
 class SlotState(NamedTuple):
-    """Per-slot device state carried (donated) step to step."""
+    """Per-slot device state carried (donated) step to step.
+
+    The sampling policy rows (``temps``/``top_ks``/``top_ps``/``seeds``/
+    ``rids``) make non-greedy decode a pure function of the carried
+    state — every draw is keyed ``(seed, rid, position)`` through the
+    stateless hash counter (``serving.sampling``), so there is no RNG
+    state to snapshot or migrate. ``hist`` is the consumed-token
+    history (prompt + generated, one scratch column at the end): the
+    speculative decoder's on-device n-gram table, maintained by the
+    step itself.
+    """
 
     tokens: jax.Array       # [B] i32 — token each slot consumes next
     positions: jax.Array    # [B] i32 — its position
     active: jax.Array       # [B] bool
     prompt_buf: jax.Array   # [B, max_seq_len] i32 — prompt (replay) text
     prompt_lens: jax.Array  # [B] i32
+    temps: jax.Array        # [B] f32 — 0 = greedy argmax
+    top_ks: jax.Array       # [B] i32 — 0 = disabled
+    top_ps: jax.Array       # [B] f32 — 1.0 = disabled
+    seeds: jax.Array        # [B] i32 — per-request PRNG seed
+    rids: jax.Array         # [B] i32 — request id (the PRNG lane key)
+    hist: jax.Array         # [B, max_seq_len + 1] i32 — consumed tokens
 
 
 def default_page_size(num_heads: int, head_dim: int) -> int:
@@ -161,6 +179,8 @@ class ServingEngine:
         clock: Optional[Callable[[], float]] = None,
         prefill_chunk: int = 1,
         prefix_cache: bool = True,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
     ):
         # recovery (recover_from) rebuilds an engine with the same
         # geometry/policies; capture the kwargs before unpacking
@@ -172,7 +192,8 @@ class ServingEngine:
             interpret=interpret, admission=admission,
             degradation=degradation, watchdog=watchdog,
             step_timeout_s=step_timeout_s, chaos=chaos, clock=clock,
-            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache)
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            spec_k=spec_k, spec_ngram=spec_ngram)
         self.cfg = cfg
         n, d = cfg.num_attention_heads, cfg.kv_channels
         ps = page_size or default_page_size(n, d)
@@ -213,11 +234,26 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_chunk {prefill_chunk} exceeds the prompt "
                 f"buffer ({self._buf_len} tokens)")
+        #: speculative decoding: draft up to `spec_k` tokens per decode
+        #: slot per step (0 = off) from an `spec_ngram`-gram lookup
+        #: over the slot's own history, verified in one target pass
+        self.spec_k = max(0, int(spec_k))
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_k > 0 and not (
+                1 <= self.spec_ngram < self._buf_len):
+            raise ValueError(
+                f"spec_ngram must be in [1, {self._buf_len}) "
+                f"(the sequence buffer), got {spec_ngram}")
+        if self.spec_k >= self._buf_len:
+            raise ValueError(
+                f"spec_k {spec_k} exceeds the sequence buffer "
+                f"({self._buf_len} tokens)")
         self.scheduler = Scheduler(self.spec, self.n_slots,
                                    max_prompt_len=self._buf_len,
                                    chaos=chaos,
                                    prefix_cache=bool(prefix_cache),
-                                   prefill_chunk=self.prefill_chunk)
+                                   prefill_chunk=self.prefill_chunk,
+                                   spec_k=self.spec_k)
         #: the per-engine radix/hash prefix index (None when disabled);
         #: per-REPLICA in a fleet — each engine's cache is private to
         #: its own pool and flushed on its own weight swaps
@@ -242,6 +278,11 @@ class ServingEngine:
         # prefilling slot; pure-decode boundaries keep using the
         # 1-token program so the decode hot path pays no chunk padding
         self._chunk_step = None
+        # the speculative draft->verify->accept program (spec_k > 0):
+        # ONE fixed-shape program of width max(prefill_chunk, spec_k+1)
+        # serves every boundary — prefill slots ride its chunk columns,
+        # decode slots verify their drafts in the same pass
+        self._spec_step = None
         self._copy_pages = jax.jit(_copy_pool_pages, donate_argnums=(0,))
         self._mutate = jax.jit(_mutate_slots, donate_argnums=(0,))
         self._occupants: List[Optional[int]] = [None] * self.n_slots
@@ -275,6 +316,10 @@ class ServingEngine:
             # longer measure prefill work) + prefix-cache attribution
             "prefill_tokens": 0, "decode_tokens": 0,
             "cached_prompt_tokens": 0,
+            # speculative decoding: drafts offered to verification vs
+            # drafts accepted (decode_tokens - accepted = the one
+            # "free" token per decode slot-step)
+            "drafted_tokens": 0, "accepted_tokens": 0,
             # cache counters are engine-lifetime; snapshot them so the
             # run summary reports THIS run's deltas
             "cache_base": (self.prefix_cache.stats()
@@ -290,6 +335,12 @@ class ServingEngine:
             active=jnp.zeros((B,), bool),
             prompt_buf=jnp.zeros((B, W), jnp.int32),
             prompt_lens=jnp.zeros((B,), jnp.int32),
+            temps=jnp.zeros((B,), jnp.float32),
+            top_ks=jnp.zeros((B,), jnp.int32),
+            top_ps=jnp.ones((B,), jnp.float32),
+            seeds=jnp.zeros((B,), jnp.int32),
+            rids=jnp.zeros((B,), jnp.int32),
+            hist=jnp.zeros((B, W + 1), jnp.int32),
         )
 
     def _build_step(self):
@@ -314,7 +365,14 @@ class ServingEngine:
             # vector as the POISONED sentinel, so quarantine costs no
             # extra host sync.
             bad = slots.active & ~jnp.all(jnp.isfinite(logits), axis=-1)
-            sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # the carried sampler: greedy rows are the exact argmax
+            # (byte-identical to the pre-sampling engine); sampled rows
+            # draw via the (seed, rid, position) hash counter — the
+            # emitted token OCCUPIES position pos + 1, which is its
+            # PRNG key
+            sampled = sample_tokens(
+                logits, slots.temps, slots.top_ks, slots.top_ps,
+                slots.seeds, slots.rids, slots.positions + 1)
             next_pos = slots.positions + 1
             still_prefill = next_pos < slots.prompt_lens
             prompt_next = jnp.take_along_axis(
@@ -326,13 +384,10 @@ class ServingEngine:
                                 sampled, jnp.int32(NO_TOKEN))
             emitted = jnp.where(bad, jnp.int32(POISONED), emitted)
             next_tok = jnp.where(still_prefill, prompt_next, sampled)
-            slots = SlotState(
+            slots = slots._replace(
                 tokens=jnp.where(slots.active, next_tok, slots.tokens),
                 positions=jnp.where(slots.active, next_pos,
                                     slots.positions),
-                active=slots.active,
-                prompt_buf=slots.prompt_buf,
-                prompt_lens=slots.prompt_lens,
             )
             if tel_every > 0:
                 metrics = telemetry.accumulate(
@@ -367,8 +422,12 @@ class ServingEngine:
             logits = jnp.where(poison[:, None], jnp.float32(jnp.nan),
                                logits)
             bad = slots.active & ~jnp.all(jnp.isfinite(logits), axis=-1)
-            sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             next_pos = slots.positions + take
+            # the emission point's logits produce the token that will
+            # OCCUPY position pos + take — its PRNG key
+            sampled = sample_tokens(
+                logits, slots.temps, slots.top_ks, slots.top_ps,
+                slots.seeds, slots.rids, next_pos)
             still_prefill = next_pos < slots.prompt_lens
             prompt_next = jnp.take_along_axis(
                 slots.prompt_buf,
@@ -377,13 +436,10 @@ class ServingEngine:
                                 sampled, jnp.int32(NO_TOKEN))
             emitted = jnp.where(bad, jnp.int32(POISONED), emitted)
             next_tok = jnp.where(still_prefill, prompt_next, sampled)
-            slots = SlotState(
+            slots = slots._replace(
                 tokens=jnp.where(slots.active, next_tok, slots.tokens),
                 positions=jnp.where(slots.active, next_pos,
                                     slots.positions),
-                active=slots.active,
-                prompt_buf=slots.prompt_buf,
-                prompt_lens=slots.prompt_lens,
             )
             if tel_every > 0:
                 metrics = telemetry.accumulate(
@@ -399,6 +455,44 @@ class ServingEngine:
         if self._chunk_step is None:
             self._chunk_step = self._build_chunk_step()
         return self._chunk_step
+
+    def _build_spec_step(self):
+        """The speculative draft->verify->accept program
+        (``spec_decode.run_spec_step``): one fixed-shape step of width
+        ``max(prefill_chunk, spec_k + 1)`` serving every boundary.
+        Same carry and donation as the other two programs; the fetched
+        array is the ``[B, C + 1]`` emitted matrix (tokens in order,
+        ``NO_TOKEN`` padding, ``POISONED`` quarantine in column 0, the
+        drafted-token count in the last column) — still ONE host sync
+        per step."""
+        cfg, spec = self.cfg, self.spec
+        spec_k, ngram = self.spec_k, self.spec_ngram
+        chunk = self.prefill_chunk
+        use_kernel, interpret = self._use_kernel, self._interpret
+        tel_every, sink = self.telemetry_every, self.sink
+
+        def step(params, kv, slots, page_tables, poison, draft_caps,
+                 metrics):
+            kv, slots, emitted = run_spec_step(
+                cfg, params, spec, kv, slots, page_tables, poison,
+                draft_caps, spec_k=spec_k, ngram=ngram,
+                prefill_chunk=chunk,
+                use_kernel=use_kernel, interpret=interpret)
+            if tel_every > 0:
+                metrics = telemetry.accumulate(
+                    metrics,
+                    tokens=jnp.sum(
+                        (emitted[:, :-1] >= 0).astype(jnp.float32)))
+                metrics = telemetry.drain(
+                    metrics, sink, every_n=tel_every, tag="serving")
+            return kv, slots, emitted, metrics
+
+        return jax.jit(step, donate_argnums=(1, 2, 6))
+
+    def _spec_step_fn(self):
+        if self._spec_step is None:
+            self._spec_step = self._build_spec_step()
+        return self._spec_step
 
     # -- audit surface -----------------------------------------------------
     def step_program(self):
@@ -417,10 +511,20 @@ class ServingEngine:
         fn, args = self.step_program()
         return self._chunk_step_fn(), args
 
+    def spec_step_program(self):
+        """(jitted speculative step, example args) — the audit surface
+        when ``spec_k > 0`` (the extra positional arg is the host's
+        per-slot draft cap)."""
+        _, args = self.step_program()
+        args = args[:5] + (jnp.zeros((self.n_slots,), jnp.int32),
+                           args[5])
+        return self._spec_step_fn(), args
+
     def audit(self, **kw):
         """Static audit of the decode step — and, when chunked prefill
-        is enabled, the chunk step too (PR-4 auditor); raises on
-        error-severity findings, returns the (last) report."""
+        / speculative decoding are enabled, those programs too (PR-4
+        auditor); raises on error-severity findings, returns the
+        (last) report."""
         from ..analysis import assert_step_clean
 
         fn, args = self.step_program()
@@ -431,6 +535,10 @@ class ServingEngine:
             cfn, cargs = self.chunk_step_program()
             report = assert_step_clean(
                 cfn, *cargs, name="serving_chunk_prefill_step", **kw)
+        if self.spec_k > 0:
+            sfn, sargs = self.spec_step_program()
+            report = assert_step_clean(
+                sfn, *sargs, name="serving_spec_decode_step", **kw)
         return report
 
     # -- request intake ----------------------------------------------------
@@ -710,6 +818,12 @@ class ServingEngine:
         active = np.zeros((B,), bool)
         prompt_buf = np.zeros((B, W), np.int32)
         prompt_lens = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
+        rids = np.zeros((B,), np.int32)
+        hist = np.zeros((B, W + 1), np.int32)
         for i in range(B):
             run = sched.slots[i]
             rid = None if run is None else run.req.rid
@@ -726,13 +840,26 @@ class ServingEngine:
             active[i] = True
             prompt_buf[i, :plen] = np.asarray(run.prompt, np.int32)
             prompt_lens[i] = plen
+            sp = resolve(run.req.sampling)
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+            seeds[i] = _i32_wrap(sp.seed)
+            rids[i] = _i32_wrap(run.req.rid)
+            # the replay prompt IS the consumed history so far (it
+            # folds generated tokens back in), so a (re)admitted slot's
+            # on-device n-gram table resumes exactly where it left off
+            hist[i, :plen] = prompt_buf[i, :plen]
         if not mask.any():
             return
         new = SlotState(
             tokens=jnp.asarray(tokens), positions=jnp.asarray(positions),
             active=jnp.asarray(active),
             prompt_buf=jnp.asarray(prompt_buf),
-            prompt_lens=jnp.asarray(prompt_lens))
+            prompt_lens=jnp.asarray(prompt_lens),
+            temps=jnp.asarray(temps), top_ks=jnp.asarray(top_ks),
+            top_ps=jnp.asarray(top_ps), seeds=jnp.asarray(seeds),
+            rids=jnp.asarray(rids), hist=jnp.asarray(hist))
         self.slots = self._mutate(self.slots, jnp.asarray(mask), new)
 
     def _poison_mask(self, step_no: int):
@@ -767,7 +894,11 @@ class ServingEngine:
 
     def run_step(self) -> np.ndarray:
         """One scheduling boundary + one device step; returns the
-        emitted-token vector ([B], -1 = no token, -2 = quarantined)."""
+        fetched emitted-token array: ``[B]`` (-1 = no token, -2 =
+        quarantined) for the plain programs, or — with ``spec_k > 0``
+        — the ``[B, C+1]`` matrix (per-slot emitted tokens in order,
+        ``NO_TOKEN`` padding, ``POISONED`` in column 0, the
+        drafted-token count in the last column)."""
         sched = self.scheduler
         step_no = self.steps_run
         if self._chaos is not None:
@@ -816,16 +947,28 @@ class ServingEngine:
         # `prefill_chunk` per prefilling slot)
         served = sched.running()
         prefill_slots = [i for i, r in served if r.prefilling]
-        decode_slots = [i for i, r in served if not r.prefilling]
+        decode_slots = {i for i, r in served if not r.prefilling}
         prefill_tokens = sum(sched.next_take(r)
                              for _, r in served if r.prefilling)
-        step_fn = (self._chunk_step_fn()
-                   if self.prefill_chunk > 1 and prefill_slots
-                   else self._step)
         t0 = time.perf_counter()
-        self.kv, self.slots, emitted, self.metrics = step_fn(
-            self.params, self.kv, self.slots, page_tables, poison,
-            self.metrics)
+        if self.spec_k > 0:
+            # the unified speculative program serves every boundary;
+            # the host's per-slot draft cap bounds drafting to the
+            # pages ensure_capacity just allocated
+            caps = np.zeros((self.n_slots,), np.int32)
+            for i, r in served:
+                caps[i] = sched.draft_cap(r)
+            self.kv, self.slots, emitted, self.metrics = \
+                self._spec_step_fn()(
+                    self.params, self.kv, self.slots, page_tables,
+                    poison, jnp.asarray(caps), self.metrics)
+        else:
+            step_fn = (self._chunk_step_fn()
+                       if self.prefill_chunk > 1 and prefill_slots
+                       else self._step)
+            self.kv, self.slots, emitted, self.metrics = step_fn(
+                self.params, self.kv, self.slots, page_tables, poison,
+                self.metrics)
         em = self._fetch_emitted(emitted, step_no)  # the one host sync
         dt = time.perf_counter() - t0
         now = self._clock()
@@ -835,18 +978,47 @@ class ServingEngine:
             # feasibility stays meaningful under an injected clock;
             # bench timing (_acct) stays on perf_counter
             self.admission.observe_step(now - boundary_t)
+        # normalize the fetched array: the legacy programs emit one
+        # token per slot ([B]); the speculative program emits a token
+        # MATRIX plus a drafted-count column ([B, C + 1])
+        if em.ndim == 1:
+            tok_rows = em[:, None]
+            drafted = np.zeros((self.n_slots,), np.int64)
+        else:
+            tok_rows = em[:, :-1]
+            drafted = em[:, -1].astype(np.int64)
         # quarantined slots are excluded from advance BEFORE it runs:
         # advance() publishes freshly completed prompt pages to the
         # prefix cache, and a slot whose logits went non-finite this
         # step wrote non-finite K/V this step — publishing it would
         # hand poisoned pages to every later request sharing the
         # prefix (cache-hit identity AND fault isolation both break)
-        bad_slots = {i for i, _ in served if int(em[i]) == POISONED}
-        sched.advance([i for i, _ in served if i not in bad_slots])
+        bad_slots = {i for i, _ in served
+                     if int(tok_rows[i, 0]) == POISONED}
+        emitted_by_slot: Dict[int, List[int]] = {}
+        consumed: Dict[int, int] = {}
         for i, run in served:
-            tok = int(em[i])
+            if i in bad_slots:
+                continue
+            toks = []
+            for t in tok_rows[i]:
+                t = int(t)
+                if t == NO_TOKEN:
+                    break
+                toks.append(t)
+            emitted_by_slot[i] = toks
+            if i in decode_slots:
+                # the cursor moved by the ACCEPTED run (first emitted
+                # token + every accepted draft) — decided on device,
+                # read off the emitted row
+                consumed[i] = len(toks)
+        sched.advance([i for i, _ in served if i not in bad_slots],
+                      consumed=consumed)
+        n_decode_tokens = 0
+        n_accepted = 0
+        for i, run in served:
             req = run.req
-            if tok == POISONED:
+            if i in bad_slots:
                 # fault isolation: quarantine ONLY this slot — evict,
                 # free its pages, finalize FAILED with provenance; the
                 # other slots' rows never mixed with its math, so their
@@ -860,29 +1032,59 @@ class ServingEngine:
                              "position": run.pos,
                              "transient": True})
                 continue
-            if tok < 0:
-                continue
-            if req.t_first_token is None:
-                req.t_first_token = now
-            req.out_tokens.append(tok)
-            if req.done:
-                req.t_done = now
-                sched.evict(i)
-                self._finalize(req, RequestStatus.COMPLETED, "done",
-                               now=now)
+            toks = emitted_by_slot.get(i) or []
+            kept = 0
+            for tok in toks:
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                req.out_tokens.append(tok)
+                kept += 1
+                if req.done:
+                    # surplus accepted tokens past max_new/EOS are
+                    # discarded with the slot — the request is done
+                    req.t_done = now
+                    sched.evict(i)
+                    self._finalize(req, RequestStatus.COMPLETED,
+                                   "done", now=now)
+                    break
+            if i in decode_slots:
+                # count only DELIVERED tokens (surplus accepted tokens
+                # truncated at EOS/max_new must not inflate the
+                # accept-rate / tokens-per-step metrics the bench gates)
+                n_decode_tokens += kept
+                n_accepted += max(0, kept - 1)
+        if self.spec_k > 0:
+            # rejected drafts' bookkeeping rollback: return the
+            # worst-case tail pages the accepted run did not reach
+            # (stale K/V inside kept pages is overwritten before the
+            # cursor can ever expose it — see Scheduler.rollback_kv)
+            for i, run in served:
+                if (i in decode_slots and i not in bad_slots
+                        and sched.slots[i] is run):
+                    sched.rollback_kv(i, run, run.pos)
         self.steps_run += 1
         self._acct(len(served), len(prefill_slots), len(decode_slots),
-                   prefill_tokens, dt)
+                   prefill_tokens, dt,
+                   n_decode_tokens=n_decode_tokens,
+                   n_drafted=int(sum(drafted[i] for i in decode_slots
+                                     if i not in bad_slots)),
+                   n_accepted=n_accepted)
         return em
 
-    def _acct(self, n_active, n_prefill, n_decode, n_prefill_tokens, dt):
+    def _acct(self, n_active, n_prefill, n_decode, n_prefill_tokens, dt,
+              *, n_decode_tokens=None, n_drafted=0, n_accepted=0):
         a = self._accum
         a["steps"] += 1
         a["active_slot_steps"] += n_active
         a["prefill_slot_steps"] += n_prefill
         a["decode_slot_steps"] += n_decode
         a["prefill_tokens"] += n_prefill_tokens
-        a["decode_tokens"] += n_decode
+        # under speculative decoding a decode slot-step emits 1 +
+        # accepted tokens; the caller counts what was actually kept
+        a["decode_tokens"] += (n_decode if n_decode_tokens is None
+                               else n_decode_tokens)
+        a["drafted_tokens"] += n_drafted
+        a["accepted_tokens"] += n_accepted
         a["step_time_s"] += dt
         a["max_queue_depth"] = max(a["max_queue_depth"],
                                    len(self.scheduler.waiting))
@@ -1081,6 +1283,21 @@ class ServingEngine:
             "prefill_tokens": a["prefill_tokens"],
             "decode_tokens": a["decode_tokens"],
             "cached_prompt_tokens": a["cached_prompt_tokens"],
+            # speculative decoding: drafts offered vs accepted, and the
+            # headline decode tokens-per-slot-step (> 1 iff speculation
+            # is accepting — the sub-one-pass-per-token measure; the
+            # admission/router cost model deliberately IGNORES this and
+            # keeps billing one token per slot-step, so speculation can
+            # only improve feasibility, never overcommit the pool)
+            "spec_k": self.spec_k,
+            "drafted_tokens": a["drafted_tokens"],
+            "accepted_tokens": a["accepted_tokens"],
+            "accept_rate": round(
+                a["accepted_tokens"] / a["drafted_tokens"], 4)
+            if a["drafted_tokens"] else None,
+            "tokens_per_step": round(
+                a["decode_tokens"] / a["decode_slot_steps"], 4)
+            if a["decode_slot_steps"] else None,
             "prefill_chunk": self.prefill_chunk,
             "prefix_cache": self.prefix_cache_run_stats(),
             "prefill_step_time_s": round(a["prefill_step_time_s"], 4),

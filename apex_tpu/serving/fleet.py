@@ -715,6 +715,8 @@ class ReplicaFleet:
                    and r.t_arrival is not None]
         per_replica = {}
         fleet_hits = fleet_misses = fleet_hit_tokens = 0
+        fleet_drafted = fleet_accepted = 0
+        fleet_decode_tokens = fleet_decode_slot_steps = 0
         for rep in self.replicas:
             a = rep.engine.run_accum
             served = [r for r in reqs if r.replica_id == rep.idx]
@@ -723,10 +725,16 @@ class ReplicaFleet:
                 fleet_hits += cache_stats["hits"]
                 fleet_misses += cache_stats["misses"]
                 fleet_hit_tokens += cache_stats["hit_tokens"]
+            fleet_drafted += a.get("drafted_tokens", 0)
+            fleet_accepted += a.get("accepted_tokens", 0)
+            fleet_decode_tokens += a.get("decode_tokens", 0)
+            fleet_decode_slot_steps += a.get("decode_slot_steps", 0)
             per_replica[str(rep.idx)] = {
                 "state": rep.state.value,
                 "steps": a["steps"],
                 "prefix_cache": cache_stats,
+                "drafted_tokens": a.get("drafted_tokens", 0),
+                "accepted_tokens": a.get("accepted_tokens", 0),
                 # per-run deltas, like the fleet-level counters — a
                 # warm fleet's second trace must not report the first
                 # trace's deaths/swaps
@@ -786,5 +794,17 @@ class ReplicaFleet:
                 round(fleet_hits / (fleet_hits + fleet_misses), 4)
                 if (fleet_hits + fleet_misses) else None),
             "prefix_hit_tokens": fleet_hit_tokens,
+            # fleet-wide speculative-decoding view (per-replica engines
+            # draft/verify independently; the router keeps billing one
+            # token per slot-step, so speculation only ever ADDS slack
+            # to its feasibility estimates)
+            "drafted_tokens": fleet_drafted,
+            "accepted_tokens": fleet_accepted,
+            "spec_accept_rate": (
+                round(fleet_accepted / fleet_drafted, 4)
+                if fleet_drafted else None),
+            "decode_tokens_per_step": (
+                round(fleet_decode_tokens / fleet_decode_slot_steps, 4)
+                if fleet_decode_slot_steps else None),
             "per_replica": per_replica,
         }

@@ -284,6 +284,22 @@ class PageAllocator:
             self._ref[p] -= 1
             self._maybe_release(p)
 
+    def release_tail(self, pages: Sequence[int],
+                     keep: int) -> List[int]:
+        """Drop this holder's reader refcount on ``pages[keep:]`` and
+        return the kept head — the un-write primitive under
+        ``Scheduler.rollback_kv`` (speculative-decode rejection and
+        cache-pressure rollback both release a slot's TAIL hold; a
+        released page another reader or the prefix index still holds
+        stays live, exactly like any other ``free``)."""
+        keep = int(keep)
+        if keep < 0:
+            raise ValueError(f"release_tail keep={keep} < 0")
+        drop = list(pages[keep:])
+        if drop:
+            self.free(drop)
+        return list(pages[:keep])
+
     def fork(self, src: int,
              dst: Optional[int] = None) -> Optional[int]:
         """Copy-on-write bookkeeping: move the caller's reader hold

@@ -1,0 +1,181 @@
+"""Carried sampling: temperature / top-k / top-p with a stateless
+on-device PRNG.
+
+The serving stack's sampling state is **carried, not stored**: every
+random draw is a pure function of ``(seed, rid, position)`` through the
+murmur3 hash-counter the fused-dropout kernels already use
+(``ops.flash_attention._hash_keep_bits`` — the PR-9 pattern: no RNG
+state tensor, no key-splitting chain). That is exactly what makes
+non-greedy decode survive the serving stack's disruption machinery:
+
+- **replay identity** — recompute-mode preemption, engine recovery and
+  fleet migration all re-run a request through the prefill replay path;
+  a position's draw depends on nothing but ``(seed, rid, position)``,
+  so the replayed request regenerates byte-identical samples wherever
+  (and whenever) it lands;
+- **reference identity** — the dense per-request oracle
+  (``decode_model.reference_sample_decode``) calls the SAME
+  :func:`sample_tokens` with the same keys, so the engine-vs-reference
+  byte-identity acceptance extends verbatim from greedy to sampled
+  decode;
+- **speculative decode** — because the sampled token at position ``p``
+  is a *deterministic* function of ``(logits_p, seed, rid, p)``, draft
+  verification reduces to an exact-match test against the position's
+  own carried draw (``spec_decode``): the accepted prefix plus the
+  first correction token are byte-identical to what plain sequential
+  sampling would have produced. This is the reparameterized form of
+  the Leviathan et al. rejection-sampling accept rule for a
+  deterministic (n-gram) draft — acceptance fires with probability
+  ``p(draft)`` either way, but the reparameterization upgrades
+  "identical in distribution" to "identical byte-for-byte", which is
+  the contract the identity oracle can actually pin.
+
+Sampling semantics (HuggingFace filter order): logits are scaled by
+``1/temperature``, the top-k filter keeps the k highest logits, the
+top-p filter then keeps the smallest set of remaining tokens whose
+probability mass reaches ``p`` (always at least one). The draw itself
+is Gumbel-max over the filtered logits — exact categorical sampling as
+one argmax, no cumsum inversion, and the filtered tokens simply sit at
+``-inf``. ``temperature == 0`` (the default) is greedy argmax,
+**bit-identical to the pre-sampling engine**: the whole sampling branch
+sits behind a ``lax.cond`` on ``any(temperature > 0)``, so pure-greedy
+traffic never pays the per-step vocab sort.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import _hash_keep_bits, _shr_logical
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy (attach as ``Request.sampling``).
+
+    - ``temperature``: 0 = greedy argmax (the default — byte-identical
+      to the historical engine); > 0 scales logits by ``1/temperature``
+      before the draw.
+    - ``top_k``: keep only the k highest logits (0 = disabled).
+    - ``top_p``: nucleus filtering — keep the smallest set of tokens
+      whose probability mass reaches ``top_p`` (1.0 = disabled).
+    - ``seed``: the PRNG seed. Draws are keyed ``(seed, rid,
+      position)``, so two requests with the same seed but different
+      rids (or the same request replayed after preemption / recovery /
+      migration) draw independently / identically respectively.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+#: the default policy: greedy argmax, no randomness consumed
+GREEDY = SamplingParams()
+
+
+def resolve(sampling: Optional[SamplingParams]) -> SamplingParams:
+    """``None`` means greedy (the Request default)."""
+    return GREEDY if sampling is None else sampling
+
+
+def i32_wrap(v: int) -> int:
+    """Wrap an arbitrary int into the int32 PRNG lane (two's
+    complement) — seeds/rids are hash keys, only their 32 bits matter.
+    Engine and dense reference both wrap through here, so byte
+    identity holds for any key value."""
+    v = int(v) & 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def uniform_from_hash(seeds: jax.Array, rids: jax.Array,
+                      positions: jax.Array, idx: jax.Array) -> jax.Array:
+    """Uniform (0, 1) f32 draws from the murmur3 hash counter, keyed
+    ``(seed, rid, position, idx)`` — the flash-attention/fused-dropout
+    ``_hash_keep_bits`` finalizer with the serving key layout (rid in
+    the ``bh`` lane, position in the ``qi`` lane, the per-vocab counter
+    in the ``ki`` lane). The top 24 hash bits become the mantissa
+    (``(bits >> 8) + 0.5) / 2^24``), so the draw is exactly
+    representable, never 0 and never 1."""
+    bits = _hash_keep_bits(seeds.astype(jnp.int32),
+                           rids.astype(jnp.int32),
+                           positions.astype(jnp.int32),
+                           idx.astype(jnp.int32))
+    return ((_shr_logical(bits, 8).astype(jnp.float32) + 0.5)
+            / jnp.float32(1 << 24))
+
+
+def _filtered_logits(logits: jax.Array, temps: jax.Array,
+                     top_ks: jax.Array, top_ps: jax.Array) -> jax.Array:
+    """Temperature-scaled logits with the top-k then top-p filters
+    applied as ``-inf`` masks ([R, V] -> [R, V]; row-independent, so a
+    batch row matches the [1, V] reference exactly)."""
+    R, V = logits.shape
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    srt = -jnp.sort(-scaled, axis=-1)                       # descending
+    # top-k: the k-th largest logit is the keep threshold (k = 0 or
+    # k >= V keeps everything)
+    k_eff = jnp.where(top_ks <= 0, V,
+                      jnp.clip(top_ks, 1, V)).astype(jnp.int32)
+    kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=1)
+    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    # top-p over the top-k survivors: keep sorted tokens whose
+    # cumulative mass BEFORE them is < p (always keeps the argmax).
+    # The sorted view of `masked` is derivable from the ONE sort above
+    # (the kept entries are exactly a prefix of the descending `srt`),
+    # so the vocab is sorted once, not twice.
+    msrt = jnp.where(srt >= kth, srt, -jnp.inf)
+    probs = jax.nn.softmax(msrt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    n_keep = jnp.sum((cum - probs) < top_ps[:, None],
+                     axis=-1).astype(jnp.int32)
+    thresh = jnp.take_along_axis(msrt, (n_keep - 1)[:, None], axis=1)
+    return jnp.where(masked >= thresh, masked, -jnp.inf)
+
+
+def sample_tokens(logits: jax.Array, temps: jax.Array,
+                  top_ks: jax.Array, top_ps: jax.Array,
+                  seeds: jax.Array, rids: jax.Array,
+                  positions: jax.Array) -> jax.Array:
+    """One token per row: ``[R, vocab]`` fp32 logits + per-row policy
+    arrays -> ``[R]`` int32 tokens.
+
+    ``positions`` is the sequence position the sampled token will
+    OCCUPY (= the PRNG counter), so a replayed / migrated / spec-
+    verified request regenerates the identical draw for every position.
+    Rows with ``temps <= 0`` take the greedy argmax — and when NO row
+    samples, the whole filtered-sampling branch is skipped via
+    ``lax.cond`` (the greedy hot path pays one ``any()`` reduction, not
+    a vocab sort)."""
+    logits = logits.astype(jnp.float32)
+    R, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def draw(_):
+        allowed = _filtered_logits(logits, temps, top_ks, top_ps)
+        vi = jax.lax.broadcasted_iota(jnp.int32, (R, V), 1)
+        u = uniform_from_hash(seeds[:, None], rids[:, None],
+                              positions[:, None], vi)
+        gumbel = -jnp.log(-jnp.log(u))
+        return jnp.argmax(allowed + gumbel, axis=-1).astype(jnp.int32)
+
+    sampled = jax.lax.cond(jnp.any(temps > 0.0), draw,
+                           lambda _: greedy_tok, operand=None)
+    return jnp.where(temps <= 0.0, greedy_tok, sampled)

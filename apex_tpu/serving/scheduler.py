@@ -39,7 +39,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 from collections import Counter, deque
-from typing import Deque, List, Optional, Sequence, Set, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -56,6 +56,7 @@ from .robustness import (
     RequestStatus,
     SchedulerError,  # noqa: F401  (re-export: historical home)
 )
+from .sampling import SamplingParams
 
 _rid_counter = itertools.count()
 
@@ -81,6 +82,10 @@ class Request:
     priority: int = 0
     ttft_budget_ms: Optional[float] = None
     latency_budget_ms: Optional[float] = None
+    # non-greedy decoding policy (None = greedy argmax — the
+    # token-identity default); draws are keyed (sampling.seed, rid,
+    # position), so replay/recovery/migration regenerate them exactly
+    sampling: Optional[SamplingParams] = None
     rid: int = dataclasses.field(
         default_factory=lambda: next(_rid_counter))
     # engine-filled results / timestamps
@@ -165,11 +170,13 @@ class Scheduler:
 
     def __init__(self, spec: PagedKVSpec, n_slots: int,
                  max_prompt_len: int, chaos=None, *,
-                 prefix_cache: bool = False, prefill_chunk: int = 1):
+                 prefix_cache: bool = False, prefill_chunk: int = 1,
+                 spec_k: int = 0):
         self.spec = spec
         self.n_slots = int(n_slots)
         self.max_prompt_len = int(max_prompt_len)
         self.prefill_chunk = max(1, int(prefill_chunk))
+        self.spec_k = max(0, int(spec_k))
         self.allocator = PageAllocator(spec.num_pages)
         self.cache: Optional[PrefixCache] = (
             PrefixCache(spec, self.allocator) if prefix_cache else None)
@@ -322,10 +329,38 @@ class Scheduler:
         """Tokens this slot consumes next step: up to ``prefill_chunk``
         prompt tokens while prefilling, exactly one while decoding.
         The engine's device step computes the same quantity in-jit —
-        host mirrors and device state advance in lockstep."""
+        host mirrors and device state advance in lockstep. (Under
+        speculative decoding a decode slot may consume MORE — see
+        :meth:`next_take_upper`; its actual advance is read back from
+        the step's emitted row, since acceptance is decided on device.)
+        """
         if run.prefilling:
             return min(self.prefill_chunk, len(run.prompt) - run.pos)
         return 1
+
+    def draft_cap(self, run: RunningSlot) -> int:
+        """How many tokens this decode slot may DRAFT next step: at
+        most ``spec_k``, and never past the last position the request
+        can consume (``max_new - emitted - 1`` more emits will be fed
+        back — the final emitted token never is), so the device never
+        writes K/V beyond what :meth:`ensure_capacity` paged. 0 while
+        prefilling (prompt ingestion needs no speculation) and when
+        speculative decoding is off."""
+        if self.spec_k <= 0 or run.prefilling:
+            return 0
+        remaining = run.req.max_new_tokens - len(run.req.out_tokens)
+        return max(0, min(self.spec_k, remaining - 1))
+
+    def next_take_upper(self, run: RunningSlot) -> int:
+        """Worst-case tokens this slot may WRITE next step — the bound
+        :meth:`ensure_capacity` pages and COW-fork-scans against: the
+        prefill chunk while prefilling, the carried token plus every
+        drafted position while decoding. Speculative writes past the
+        accepted prefix are rolled back as bookkeeping
+        (:meth:`rollback_kv`) after the step."""
+        if run.prefilling:
+            return self.next_take(run)
+        return 1 + self.draft_cap(run)
 
     def _fork_index(self, run: RunningSlot, end: int) -> Optional[int]:
         """The first page index this step's writes touch that is
@@ -341,24 +376,58 @@ class Scheduler:
                 return j
         return None
 
+    def rollback_kv(self, i: int, run: RunningSlot, new_pos: int, *,
+                    keep_pages: Optional[int] = None) -> None:
+        """Un-write a slot's last-n KV positions: ONE bookkeeping path
+        for every consumer that wrote ahead of where the cursor ends up
+        — speculative-decode rejection (drafted positions past the
+        accepted prefix; ``new_pos`` = the already-advanced cursor,
+        only the worst-case tail pages are returned) and the PR-12
+        cache-pressure rollback (``_rollback_cached``: cursor rewinds
+        to a page boundary and the shared head recomputes).
+
+        Frees the slot's hold on pages ``keep_pages:`` (default: just
+        enough to cover ``new_pos`` consumed tokens), cancels any
+        pending COW fork whose destination dies with them, rewinds the
+        cursor (marking the slot dirty so the engine re-pushes its
+        device row), and trims the publication watermark + digest memo
+        to the kept pages. Un-written positions inside a KEPT page are
+        plain bookkeeping: every future read is masked to ``kv_len =
+        pos + 1`` entries, so a stale entry is overwritten by the
+        cursor before anything can attend to it — and a kept page is
+        never shared (writes into shared pages COW-forked before the
+        step; ``check_invariants`` cross-checks the refcounts).
+        """
+        if keep_pages is None:
+            keep_pages = self.spec.pages_for(new_pos)
+        keep_pages = min(int(keep_pages), len(run.pages))
+        drop = run.pages[keep_pages:]
+        if drop:
+            # a pending COW copy whose destination is being released
+            # must not fire (the freed dst may be re-allocated to
+            # another slot this same boundary) — the _free_slot rule
+            if self._forks:
+                gone = set(drop)
+                self._forks = [(s, d) for s, d in self._forks
+                               if d not in gone]
+            run.pages = self.allocator.release_tail(run.pages,
+                                                    keep_pages)
+        if new_pos != run.pos:
+            run.pos = int(new_pos)
+            self._dirty.add(i)
+        run.published = min(run.published, keep_pages)
+        del run.digests[keep_pages:]
+
     def _rollback_cached(self, i: int, run: RunningSlot,
                          from_j: int) -> None:
         """Pressure fallback when no page can be found for a COW fork:
         release this slot's hold on pages ``from_j:`` and rewind the
-        prefill cursor to recompute them. The released pages become
-        zero-reader cache entries — exactly what :meth:`evict_one` can
-        now free — so the retry always makes progress, and the
-        deterministic replay keeps token identity."""
-        drop = run.pages[from_j:]
-        if drop:
-            self.allocator.free(drop)
-        run.pages = run.pages[:from_j]
+        prefill cursor to recompute them (:meth:`rollback_kv`). The
+        released pages become zero-reader cache entries — exactly what
+        :meth:`evict_one` can now free — so the retry always makes
+        progress, and the deterministic replay keeps token identity."""
         new_pos = min(run.pos, from_j * self.spec.page_size)
-        if new_pos != run.pos:
-            run.pos = new_pos
-            self._dirty.add(i)
-        run.published = min(run.published, from_j)
-        del run.digests[from_j:]
+        self.rollback_kv(i, run, new_pos, keep_pages=from_j)
         # tokens counted as cache-skipped that will now be recomputed:
         # give them back (prefill_tokens_saved must not overstate the
         # cache win when pressure rollback fires)
@@ -394,7 +463,7 @@ class Scheduler:
             if self.slots[i] is not run:
                 continue  # preempted / yielded earlier in this loop
             while self.slots[i] is run:
-                end = run.pos + self.next_take(run)
+                end = run.pos + self.next_take_upper(run)
                 fork_j = self._fork_index(run, end)
                 if (fork_j is None
                         and len(run.pages) >= self.spec.pages_for(end)):
@@ -550,17 +619,24 @@ class Scheduler:
         ]
         return np.stack(rows)
 
-    def advance(self, slot_indices: Sequence[int]) -> None:
+    def advance(self, slot_indices: Sequence[int],
+                consumed: Optional[Dict[int, int]] = None) -> None:
         """Consume this step's tokens on each given slot — one while
         decoding, up to ``prefill_chunk`` while prefilling (the same
         :meth:`next_take` the device step computes in-jit) — and
-        publish freshly completed prompt pages to the prefix index."""
+        publish freshly completed prompt pages to the prefix index.
+
+        ``consumed`` overrides the advance per slot index: under
+        speculative decoding a decode slot's cursor moves by its
+        ACCEPTED token count, which the host learns from the step's
+        emitted row rather than computing a priori."""
         for i in slot_indices:
             run = self.slots[i]
             if run is None:
                 raise SchedulerError(f"advance on empty slot {i}")
             was_prefilling = run.prefilling
-            run.pos += self.next_take(run)
+            take = (consumed or {}).get(i)
+            run.pos += self.next_take(run) if take is None else int(take)
             if self.cache is not None and was_prefilling:
                 self._publish(run)
 

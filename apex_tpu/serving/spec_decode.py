@@ -1,0 +1,276 @@
+"""Speculative decoding: self-drafted n-gram lookahead, verified in one
+target pass — decode below one model pass per token.
+
+The decode hot path pays one full target-model forward per emitted
+token; this module spends ONE chunk-shaped pass (the PR-12
+``prefill_chunk_tokens`` program shape, reused verbatim through
+``decode_model.chunk_hidden``) to verify ``k + 1`` positions at once:
+
+- **draft** — each decoding slot proposes up to ``spec_k`` tokens from
+  an n-gram lookup over its OWN prompt + emitted history
+  (prompt-lookup / self-speculative decoding: no second model). The
+  history is the on-device ``SlotState.hist`` buffer the step itself
+  maintains, so drafting is in-jit — zero extra host syncs, and a
+  replayed / migrated request reconstructs the same table from the
+  same history;
+- **verify** — the drafted tokens ride the chunk program as extra
+  columns: column ``j`` consumes draft ``j`` at position ``pos + j``,
+  its K/V is scattered before attention, and per-column ``kv_lens``
+  make in-chunk attention causal by construction — exactly the PR-12
+  prefill chunk, so one target pass yields trusted logits at every
+  position whose inputs were correct;
+- **accept** — in-jit, per slot: the sampled token at each position is
+  a *deterministic* function of ``(logits, seed, rid, position)``
+  (``sampling.sample_tokens``), so draft ``j`` is accepted iff it
+  equals the position's own carried draw. The emitted run is the
+  accepted prefix plus the first correction token — byte-identical to
+  plain sequential decode (greedy: argmax match ⇒ the lossless
+  contract; sampled: the reparameterized Leviathan rejection rule — a
+  deterministic draft is accepted with probability ``p(draft)`` either
+  way, and the correction token IS the residual draw, read off the
+  position's carried PRNG);
+- **rollback** — rejected columns wrote K/V the sequence will never
+  read: every read at position ``p`` is masked to ``kv_len = p + 1``
+  entries, and the cursor rewinds to the first rejection, so stale
+  entries are overwritten before the cursor ever passes them. The only
+  real bookkeeping is host-side: ``Scheduler.rollback_kv`` returns the
+  speculative tail pages (allocated for the worst case, unused after a
+  short accept) to the pool each boundary — the same helper the PR-12
+  cache-pressure rollback path uses. Shared (prefix-cache) pages were
+  COW-forked BEFORE the step's writes (``ensure_capacity`` sizes its
+  fork scan to the speculative worst case), so a rejected draft can
+  never scribble on a page another reader holds.
+
+Prefilling slots ride along unchanged (their columns consume prompt
+tokens, ``min(prefill_chunk, remaining)`` per step, and they never
+draft), so one fixed-shape program of width ``max(prefill_chunk,
+spec_k + 1)`` serves every boundary — prefill, decode and mixed — and
+the scheduler's slot accounting, admission billing and preemption
+machinery see nothing new except tokens-per-step > 1.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .decode_model import chunk_hidden, lm_logits
+from .kv_cache import KVCacheState, PagedKVSpec
+from .sampling import sample_tokens
+
+Pytree = object
+
+#: emitted-token sentinels — the host ABI's single definition (the
+#: engine imports these; only spec_decode -> engine would be a cycle)
+NO_TOKEN = -1
+POISONED = -2
+
+
+def ngram_propose(hist: jax.Array, lens: jax.Array, *, k: int,
+                  n: int) -> Tuple[jax.Array, jax.Array]:
+    """Prompt-lookup drafting, in-jit: for each row, match the LAST
+    ``n`` known tokens against every earlier window of the history and
+    propose the continuation of the most recent match.
+
+    ``hist`` is ``[B, W + 1]`` int32 (column ``W`` is the scratch sink
+    inactive scatters target — never read); ``lens`` is how many head
+    tokens of each row are known (0 disables the row). Returns
+    ``(drafts [B, k] int32, n_draft [B] int32)`` with unused draft
+    slots zeroed. A row drafts only when a match exists strictly before
+    the tail n-gram itself, and never proposes past its known history —
+    correctness never depends on it (every draft is verified), only
+    the accept rate does.
+    """
+    B, W1 = hist.shape
+    W = W1 - 1
+    if k < 1:
+        return (jnp.zeros((B, 1), jnp.int32)[:, :0],
+                jnp.zeros((B,), jnp.int32))
+    lens = lens.astype(jnp.int32)
+    # the tail n-gram to match: hist[b, lens-n : lens]
+    tpos = lens[:, None] - n + jnp.arange(n, dtype=jnp.int32)[None, :]
+    tgt = jnp.take_along_axis(hist, jnp.clip(tpos, 0, W), axis=1)
+    # eq[b, s] = the window starting at s matches the tail n-gram
+    S = W - n + 1
+    eq = None
+    for i in range(n):
+        col = jax.lax.dynamic_slice_in_dim(hist, i, S, axis=1)
+        m = col == tgt[:, i][:, None]
+        eq = m if eq is None else (eq & m)
+    s_iota = jnp.arange(S, dtype=jnp.int32)[None, :]
+    # a usable match starts strictly before the tail (s < lens - n) —
+    # which also guarantees at least one continuation token exists
+    ok = eq & (s_iota < (lens - n)[:, None]) & (lens[:, None] > n)
+    best = jnp.max(jnp.where(ok, s_iota, -1), axis=1)    # most recent
+    found = best >= 0
+    cont = best + n                                       # continuation
+    dpos = cont[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    drafts = jnp.take_along_axis(hist, jnp.clip(dpos, 0, W), axis=1)
+    n_draft = jnp.where(found,
+                        jnp.minimum(lens - cont, k), 0).astype(jnp.int32)
+    dvalid = jnp.arange(k, dtype=jnp.int32)[None, :] < n_draft[:, None]
+    return jnp.where(dvalid, drafts, 0).astype(jnp.int32), n_draft
+
+
+def run_spec_step(
+    cfg,
+    params: Pytree,
+    spec: PagedKVSpec,
+    kv: KVCacheState,
+    slots,                   # engine.SlotState (sampling + hist carried)
+    page_tables: jax.Array,  # [B, pages_per_seq] int32
+    poison: jax.Array,       # [B] bool — chaos seam
+    draft_caps: jax.Array,   # [B] int32 — host page/budget cap per slot
+    *,
+    spec_k: int,
+    ngram: int,
+    prefill_chunk: int,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """One unified draft→verify→accept step over every slot.
+
+    Returns ``(kv, slots, emitted_ex)`` where ``emitted_ex`` is
+    ``[B, C + 1]`` int32: columns ``0..C-1`` are this step's emitted
+    tokens in order (``NO_TOKEN`` padding; ``POISONED`` in column 0
+    quarantines the slot), and column ``C`` is the slot's drafted-token
+    count — so the host's ONE fetched array carries tokens, fault
+    verdicts AND the speculation accounting.
+
+    ``draft_caps`` bounds each slot's draft length to what the host
+    actually allocated pages for (``Scheduler.draft_cap``: the
+    remaining token budget) — the device must never write K/V beyond
+    the slot's page table, because an accepted token whose K/V landed
+    on the garbage page would be silently lost.
+    """
+    B = slots.tokens.shape[0]
+    C = max(int(prefill_chunk), int(spec_k) + 1)
+    W1 = slots.hist.shape[1]
+    W = W1 - 1
+
+    active = slots.active
+    pos0 = jnp.where(active, slots.positions, 0).astype(jnp.int32)
+    plen = slots.prompt_lens.astype(jnp.int32)
+    prefilling = pos0 < plen
+    decoding = active & ~prefilling
+
+    # 1. complete the known history: the carried token is consumed at
+    # pos0 this step (inactive rows scatter to the scratch column W)
+    dest0 = jnp.where(active, pos0, W)
+    hist = slots.hist.at[jnp.arange(B), dest0].set(
+        slots.tokens.astype(jnp.int32))
+
+    # 2. draft: n-gram lookup over each decoding slot's own history
+    # (known tokens = everything consumed + the carried token)
+    lens = jnp.where(decoding, pos0 + 1, 0)
+    if spec_k > 0:
+        drafts, n_draft = ngram_propose(hist, lens, k=spec_k, n=ngram)
+        n_draft = jnp.minimum(n_draft, jnp.maximum(draft_caps, 0))
+        n_draft = jnp.where(decoding, n_draft, 0).astype(jnp.int32)
+    else:
+        drafts = jnp.zeros((B, 0), jnp.int32)
+        n_draft = jnp.zeros((B,), jnp.int32)
+
+    # 3. per-slot consumption: prompt chunk while prefilling, the
+    # carried token + accepted-cap drafts while decoding
+    take = jnp.where(
+        active,
+        jnp.where(prefilling,
+                  jnp.minimum(prefill_chunk, plen - pos0),
+                  1 + n_draft),
+        0).astype(jnp.int32)
+
+    cols = jnp.arange(C, dtype=jnp.int32)
+    p = pos0[:, None] + cols[None, :]                    # [B, C]
+    valid = cols[None, :] < take[:, None]
+    draft_col = jnp.concatenate(
+        [slots.tokens[:, None].astype(jnp.int32),
+         jnp.pad(drafts, ((0, 0), (0, C - 1 - drafts.shape[1])))],
+        axis=1)                                          # [B, C]
+    prompt_tok = jnp.take_along_axis(hist, jnp.minimum(p, W), axis=1)
+    tok = jnp.where(p < plen[:, None], prompt_tok, draft_col)
+    tok = jnp.where(valid, tok, 0).astype(jnp.int32)
+    pclamp = jnp.where(valid, p, 0)
+
+    # 4. write every consumed token into the history (prompt columns
+    # rewrite their own value; draft columns extend it — stale rejected
+    # entries beyond the rewound cursor are overwritten before any
+    # later lookup includes them, the same argument as the KV pool)
+    destc = jnp.where(valid, p, W)
+    hist = hist.at[jnp.arange(B)[:, None], destc].set(tok)
+
+    # 5. ONE chunk-shaped target pass verifies all C positions
+    h, pages = chunk_hidden(cfg, params, spec, kv, tok, pclamp, valid,
+                            page_tables, use_kernel=use_kernel,
+                            interpret=interpret)
+    logits = lm_logits(cfg, params, h)                   # [B, C, V]
+    logits = jnp.where(poison[:, None, None], jnp.float32(jnp.nan),
+                       logits)
+
+    # 6. the position-keyed deterministic draw at every column — the
+    # token sequential decode WOULD emit from these logits
+    V = logits.shape[-1]
+
+    def rep(a):
+        return jnp.broadcast_to(a[:, None], (B, C)).reshape(B * C)
+
+    e = sample_tokens(
+        logits.reshape(B * C, V),
+        rep(slots.temps), rep(slots.top_ks), rep(slots.top_ps),
+        rep(slots.seeds), rep(slots.rids),
+        (pclamp + 1).reshape(B * C)).reshape(B, C)
+
+    # 7. accept: draft j survives iff it equals position pos+j's own
+    # carried draw AND every earlier draft survived
+    match = (tok[:, 1:] == e[:, :-1]) & valid[:, 1:]
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    m = jnp.sum(acc, axis=1).astype(jnp.int32)           # accepted drafts
+    n_emit_dec = m + 1
+    new_pos = pos0 + jnp.where(prefilling, take, n_emit_dec)
+    finished_prefill = prefilling & (new_pos >= plen)
+
+    # fault isolation: non-finite logits in a column that feeds an
+    # EMITTED token quarantine the slot (POISONED in column 0 of the
+    # fetched array). For a decode slot that is the accepted run only
+    # — a REJECTED draft column's logits are computation plain
+    # sequential decode would never have performed, and its garbage is
+    # rolled back with the draft; quarantining on it would FAIL a
+    # request plain decode completes, breaking the lossless contract.
+    nonfin = ~jnp.all(jnp.isfinite(logits), axis=-1)     # [B, C]
+    emit_cols = jnp.where(prefilling[:, None], valid,
+                          cols[None, :] < n_emit_dec[:, None])
+    bad = active & jnp.any(emit_cols & nonfin, axis=1)
+
+    last_idx = jnp.clip(take - 1, 0, C - 1)
+    e_last = jnp.take_along_axis(e, last_idx[:, None], axis=1)[:, 0]
+    e_m = jnp.take_along_axis(e, jnp.clip(m, 0, C - 1)[:, None],
+                              axis=1)[:, 0]
+
+    j = cols[None, :]
+    emitted = jnp.full((B, C), NO_TOKEN, jnp.int32)
+    emitted = jnp.where(decoding[:, None] & (j < n_emit_dec[:, None]),
+                        e, emitted)
+    emitted = jnp.where(finished_prefill[:, None] & (j == 0),
+                        e_last[:, None], emitted)
+    emitted = jnp.where(bad[:, None],
+                        jnp.where(j == 0, jnp.int32(POISONED),
+                                  jnp.int32(NO_TOKEN)), emitted)
+    emitted = jnp.where(active[:, None], emitted, jnp.int32(NO_TOKEN))
+
+    # 8. carry: the next token each slot consumes (prompt next while
+    # prefilling, else the last emitted token), at its rewound cursor
+    still_prefill = new_pos < plen
+    prompt_next = jnp.take_along_axis(
+        hist, jnp.minimum(new_pos, W)[:, None], axis=1)[:, 0]
+    next_tok = jnp.where(still_prefill, prompt_next,
+                         jnp.where(prefilling, e_last, e_m))
+    slots = slots._replace(
+        tokens=jnp.where(active, next_tok, slots.tokens),
+        positions=jnp.where(active, new_pos, slots.positions),
+        hist=hist,
+    )
+    emitted_ex = jnp.concatenate(
+        [emitted,
+         jnp.where(decoding & ~bad, n_draft, 0)[:, None]], axis=1)
+    return KVCacheState(pages=pages), slots, emitted_ex

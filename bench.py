@@ -1153,6 +1153,142 @@ def bench_prefix_reuse():
     }}
 
 
+def bench_spec_decode():
+    """``spec_decode`` leg: speculative decoding A/B against the
+    ``spec_k=0`` baseline on the deadline-armed overload-style trace
+    (ISSUE-13).
+
+    The SAME request storm (2x the sustainable arrival rate, per-
+    request latency/TTFT budgets, bounded-queue admission + shedding —
+    the ``serving_overload`` configuration) runs twice: a plain engine
+    and one with self-speculative n-gram decoding at
+    ``BENCH_SPEC_K`` (default 4) drafts per decode slot-step. What is
+    measured is the sub-one-pass-per-token contract at EQUAL SLO
+    attainment: **goodput tok/s** (tokens of in-budget completions per
+    second) for both sides, the **accept rate** (drafts surviving
+    verification), decode **tokens/step** (> 1 iff speculation is
+    paying), and zero page leaks. ``compare_bench`` gates
+    ``spec_goodput`` / ``spec_accept_rate`` / ``spec_tokens_per_step``.
+
+    Honesty notes: the trace's acceptance comes from real repetition —
+    random-init weights greedy-decode into repeating runs, exactly the
+    structure n-gram lookup exploits; a model that never repeats
+    drafts nothing and pays only the (rolled-back) verify columns. The
+    baseline engine is built with the same chunk/pool geometry, so the
+    A/B isolates speculation, and the admission controller keeps
+    billing one token per slot-step on BOTH sides (speculation is
+    upside the router never promises).
+    """
+    import numpy as _np
+
+    from apex_tpu.serving import (
+        AdmissionConfig, DegradationPolicy, Request, ServingEngine,
+    )
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    spec_ngram = int(os.environ.get("BENCH_SPEC_NGRAM", "2"))
+    factor = float(os.environ.get("BENCH_OVERLOAD_FACTOR", "2.0"))
+    n_req = int(os.environ.get("BENCH_OVERLOAD_REQUESTS", "24"))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+    def mk_trace(interval, budget_ms, ttft_ms):
+        rng = _np.random.default_rng(0)
+        return [Request(
+            prompt=[int(t) for t in
+                    rng.integers(0, cfg.vocab_size, size=prompt_len)],
+            max_new_tokens=max_new, arrival_step=i * interval,
+            latency_budget_ms=budget_ms, ttft_budget_ms=ttft_ms,
+            priority=int(rng.integers(0, 3)))
+            for i in range(n_req)]
+
+    def mk_engine(k):
+        return ServingEngine(
+            cfg, params, n_slots=n_slots, prefill_chunk=chunk,
+            spec_k=k, spec_ngram=spec_ngram,
+            admission=AdmissionConfig(max_queue=2 * n_slots,
+                                      high_watermark=0.75,
+                                      low_watermark=0.375),
+            degradation=DegradationPolicy(shed_after=3),
+            telemetry_every=0, sink=telemetry_recorder())
+
+    # calibration on the BASELINE engine: prime compile caches + the
+    # step-time estimate the shared budgets scale from (one budget set
+    # for both sides — equal SLO, that is the point)
+    calib = mk_engine(0)
+    calib_reqs = mk_trace(0, None, None)[:min(4, n_slots)]
+    calib.generate(calib_reqs)
+    step_ms = calib.last_stats["step_ms"].get("p50") or 1.0
+    del calib
+
+    service_steps = prompt_len + max_new
+    sustainable_interval = max(1, service_steps // n_slots)
+    interval = max(1, int(sustainable_interval / factor))
+    budget_ms = service_steps * step_ms * 3.0
+    ttft_ms = prompt_len * step_ms * 4.0
+    max_steps = service_steps * n_req + 1000
+
+    def run(k):
+        eng = mk_engine(k)
+        eng.generate(mk_trace(interval, budget_ms, ttft_ms),
+                     max_steps=max_steps)
+        eng.scheduler.check_invariants()
+        leaks = eng.scheduler.allocator.used_count
+        return eng.last_stats, leaks
+
+    base_st, base_leaks = run(0)
+    spec_st, spec_leaks = run(spec_k)
+    return {"spec_decode": {
+        "spec_k": spec_k,
+        "spec_ngram": spec_ngram,
+        "prefill_chunk": chunk,
+        "overload_factor": factor,
+        "n_requests": n_req,
+        "arrival_interval_steps": interval,
+        # the gated side: the speculative engine's goodput/SLO
+        "goodput_tokens_per_sec": spec_st["goodput_tokens_per_sec"],
+        "tokens_per_sec": spec_st["tokens_per_sec"],
+        "slo_attainment": spec_st["slo_attainment"],
+        "by_status": spec_st["by_status"],
+        "accept_rate": spec_st["accept_rate"],
+        "drafted_tokens": spec_st["drafted_tokens"],
+        "accepted_tokens": spec_st["accepted_tokens"],
+        "tokens_per_step": spec_st["tokens_per_step"],
+        "steps": spec_st["steps"],
+        "ttft_p99_ms": spec_st["ttft_ms"].get("p99"),
+        # the k=0 side of the A/B
+        "baseline_goodput_tokens_per_sec":
+            base_st["goodput_tokens_per_sec"],
+        "baseline_tokens_per_sec": base_st["tokens_per_sec"],
+        "baseline_slo_attainment": base_st["slo_attainment"],
+        "baseline_steps": base_st["steps"],
+        "baseline_ttft_p99_ms": base_st["ttft_ms"].get("p99"),
+        "goodput_ratio": (round(
+            spec_st["goodput_tokens_per_sec"]
+            / base_st["goodput_tokens_per_sec"], 4)
+            if base_st["goodput_tokens_per_sec"] else None),
+        "latency_budget_ms": round(budget_ms, 1),
+        "ttft_budget_ms": round(ttft_ms, 1),
+        "page_leaks": spec_leaks + base_leaks,
+        "slots": n_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "layers": layers,
+    }}
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -1633,6 +1769,22 @@ def main() -> None:
             print(f"prefix reuse bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # speculative-decoding leg: the k-vs-0 A/B on the overload trace —
+    # goodput at equal SLO attainment, accept rate, decode tokens/step
+    # (ISSUE-13). Gated like the serving legs (BENCH_SPEC_DECODE
+    # overrides; BENCH_SPEC_K sets the draft depth).
+    spec_decode = None
+    want_spec = os.environ.get("BENCH_SPEC_DECODE", want_serving)
+    if want_spec != "0" and (not fast or want_spec == "1"):
+        try:
+            spec_decode = _retry_transient(
+                bench_spec_decode, tag="spec decode leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"spec decode bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -1704,6 +1856,7 @@ def main() -> None:
         "serving_overload": (serving_overload or {}).get("serving_overload"),
         "serving_fleet": (serving_fleet or {}).get("serving_fleet"),
         "prefix_reuse": (prefix_reuse or {}).get("prefix_reuse"),
+        "spec_decode": (spec_decode or {}).get("spec_decode"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

@@ -52,6 +52,9 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("prefix_hit_rate", "prefix_reuse.hit_rate", True),
     ("prefix_flops_saved", "prefix_reuse.prefill_flops_saved", True),
     ("serving_overload_ttft_p99_ms", "serving_overload.ttft_p99_ms", False),
+    ("spec_goodput", "spec_decode.goodput_tokens_per_sec", True),
+    ("spec_accept_rate", "spec_decode.accept_rate", True),
+    ("spec_tokens_per_step", "spec_decode.tokens_per_step", True),
     ("fleet_slo_attainment", "serving_fleet.slo_attainment", True),
     ("fleet_goodput", "serving_fleet.goodput_tokens_per_sec", True),
     ("fleet_requests_lost", "serving_fleet.requests_lost", False),

@@ -37,6 +37,21 @@ oracle extended verbatim):
                       verified — with the in-jit telemetry drain ARMED,
                       so the cond-gating is what is being audited.
 
+Sampling / speculative-decoding legs (ISSUE-13 — tokens/step > 1
+without giving up the identity oracle):
+
+- ``spec_greedy_identity``  greedy decode with ``spec_k > 0`` (n-gram
+                      draft -> one-pass verify -> longest-matched-
+                      prefix accept) is token-identical to plain
+                      greedy on the staggered trace, AND on a
+                      repetition-heavy trace it must actually accept:
+                      fewer engine steps, decode tokens/step > 1.
+- ``sampled_seeded_identity``  temperature/top-k/top-p decode with the
+                      carried (seed, rid, position) hash-counter PRNG
+                      is byte-identical to the seeded dense reference
+                      (``reference_sample_decode``), speculation off
+                      and on, greedy riders in the same batch.
+
 Chaos legs (``serving.robustness`` + ``resilience.ServingChaos`` — the
 engine must DEGRADE, not corrupt, under injected faults):
 
@@ -261,14 +276,138 @@ def check_prefix_hit_identity() -> dict:
             "page_leaks": eng.scheduler.allocator.used_count}
 
 
+def check_spec_greedy_identity() -> dict:
+    """The lossless contract: speculative decoding (``spec_k > 0``)
+    under greedy sampling is TOKEN-IDENTICAL to plain greedy decode —
+    on the staggered continuous-batching trace (tiny pool: shared
+    slots, possible preemption) AND on a repetition-heavy trace where
+    drafting actually accepts (position-independent model -> cyclic
+    greedy decode), where it must also finish in fewer engine steps
+    with decode tokens/step > 1."""
+    import numpy as np
+
+    from apex_tpu.serving import Request, ServingEngine, reference_decode
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+
+    def mk():
+        rng = np.random.default_rng(7)
+        return [
+            Request(prompt=list(rng.integers(0, cfg.vocab_size, size=L)),
+                    max_new_tokens=8, arrival_step=2 * i)
+            for i, L in enumerate((14, 11, 13, 9))
+        ]
+
+    refs = {i: reference_decode(cfg, params, r.prompt, r.max_new_tokens)
+            for i, r in enumerate(mk())}
+    mismatches = []
+    reqs = mk()
+    eng = ServingEngine(cfg, params, n_slots=2, num_pages=6,
+                        max_prompt_len=16, spec_k=3)
+    out = eng.generate(reqs, max_steps=2000)
+    eng.scheduler.check_invariants()
+    for i, r in enumerate(reqs):
+        if out[r.rid] != refs[i]:
+            mismatches.append({"req": i, "engine": out[r.rid],
+                               "reference": refs[i]})
+    if eng.scheduler.allocator.used_count:
+        mismatches.append({"page_leaks":
+                           eng.scheduler.allocator.used_count})
+    # the accepting half: a cyclic (position-free) model repeats, so
+    # the n-gram draft nails the continuation — speculation must BOTH
+    # stay lossless and actually go below one pass per token
+    import jax
+
+    cyc = jax.tree_util.tree_map(lambda x: x, params)
+    cyc["embedding"]["position"] = params["embedding"]["position"] * 0.0
+    rng = np.random.default_rng(3)
+    prompt = list(rng.integers(0, cfg.vocab_size, size=8))
+    ref = reference_decode(cfg, cyc, prompt, 24)
+    stats = {}
+    for k in (0, 4):
+        req = Request(prompt=list(prompt), max_new_tokens=24)
+        eng = ServingEngine(cfg, cyc, n_slots=2, num_pages=12,
+                            max_prompt_len=48, prefill_chunk=4,
+                            spec_k=k)
+        out = eng.generate([req], max_steps=500)
+        eng.scheduler.check_invariants()
+        if out[req.rid] != ref:
+            mismatches.append({"cyclic_spec_k": k, "engine": out[req.rid],
+                               "reference": ref})
+        stats[k] = {"steps": eng.last_stats["steps"],
+                    "accept_rate": eng.last_stats["accept_rate"],
+                    "tokens_per_step": eng.last_stats["tokens_per_step"]}
+    speedup_ok = stats[4]["steps"] < stats[0]["steps"]
+    accept_ok = ((stats[4]["accept_rate"] or 0) > 0
+                 and (stats[4]["tokens_per_step"] or 0) > 1)
+    ok = not mismatches and speedup_ok and accept_ok
+    return {"ok": ok, "mismatches": mismatches,
+            "cyclic_stats": stats, "spec_fewer_steps": speedup_ok,
+            "spec_accepting": accept_ok}
+
+
+def check_sampled_seeded_identity() -> dict:
+    """Non-greedy decode is BYTE-identical to the seeded dense
+    reference (``reference_sample_decode``: same temperature/top-k/
+    top-p filters, same (seed, rid, position) hash-counter draws) —
+    with speculation off AND on, across a mixed sampled/greedy batch
+    on a tiny pool."""
+    import numpy as np
+
+    from apex_tpu.serving import (
+        Request, SamplingParams, ServingEngine, reference_sample_decode,
+    )
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    sps = [SamplingParams(temperature=0.9, top_k=20, seed=11),
+           SamplingParams(temperature=1.2, top_p=0.85, seed=42),
+           None,  # greedy rider in the same batch
+           SamplingParams(temperature=0.7, top_k=12, top_p=0.9, seed=7)]
+
+    def mk():
+        rng = np.random.default_rng(5)
+        return [Request(prompt=list(rng.integers(0, cfg.vocab_size,
+                                                 size=L)),
+                        max_new_tokens=8, arrival_step=i, sampling=sp,
+                        rid=31_000 + i)
+                for i, (L, sp) in enumerate(zip((12, 9, 11, 8), sps))]
+
+    refs = {i: reference_sample_decode(cfg, params, r.prompt,
+                                       r.max_new_tokens,
+                                       sampling=r.sampling, rid=r.rid)
+            for i, r in enumerate(mk())}
+    mismatches = []
+    for k in (0, 3):
+        reqs = mk()
+        eng = ServingEngine(cfg, params, n_slots=2, num_pages=6,
+                            max_prompt_len=16, prefill_chunk=3,
+                            spec_k=k)
+        out = eng.generate(reqs, max_steps=2000)
+        eng.scheduler.check_invariants()
+        for i, r in enumerate(reqs):
+            if out[r.rid] != refs[i]:
+                mismatches.append({"spec_k": k, "req": i,
+                                   "engine": out[r.rid],
+                                   "reference": refs[i]})
+        if eng.scheduler.allocator.used_count:
+            mismatches.append({"spec_k": k, "page_leaks":
+                               eng.scheduler.allocator.used_count})
+    return {"ok": not mismatches, "mismatches": mismatches}
+
+
 def check_step_audit() -> dict:
     from apex_tpu.serving import ServingEngine
     from apex_tpu.telemetry import RingBufferRecorder
 
     cfg = _tiny_cfg()
     params = _tiny_params(cfg)
-    eng = ServingEngine(cfg, params, n_slots=2, num_pages=5,
+    # prefill_chunk > 1 and spec_k > 0 arm ALL THREE programs — the
+    # audit covers the 1-token, chunked-prefill and speculative steps
+    eng = ServingEngine(cfg, params, n_slots=2, num_pages=8,
                         max_prompt_len=16, telemetry_every=4,
+                        prefill_chunk=3, spec_k=2,
                         sink=RingBufferRecorder())
     try:
         report = eng.audit()
@@ -515,6 +654,8 @@ CHECKS = {
     "decode_parity": check_decode_parity,
     "chunked_prefill_identity": check_chunked_prefill_identity,
     "prefix_hit_identity": check_prefix_hit_identity,
+    "spec_greedy_identity": check_spec_greedy_identity,
+    "sampled_seeded_identity": check_sampled_seeded_identity,
     "fleet_kill_migrate": check_fleet_kill_migrate,
     "fleet_drain_join": check_fleet_drain_join,
     "token_identity": check_token_identity,
