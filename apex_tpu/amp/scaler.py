@@ -116,6 +116,88 @@ class LossScaler:
         nstate = monitor.observe(nstate, leaf_nonfinite=leaf_flags)
         return out, state._replace(found_inf=state.found_inf | found), nstate
 
+    def unscale_flat(
+        self, state: LossScaleState, flat_grads, out_dtype=None,
+        numerics=None, *, chunk_size: Optional[int] = None,
+        use_kernel: Optional[bool] = None, interpret: bool = False,
+    ):
+        """Unscale a PACKED flat gradient buffer, recording overflow —
+        the scaler-over-flat-buffers leg of the bucketed gradient
+        lifecycle (``parallel.GradBuckets``).
+
+        One chunked ``multi_tensor_scale_flat(per_row_flags=True)``
+        sweep yields the unscaled buffer, the step's ``found_inf`` AND
+        per-ROW non-finite flags; pass ``out_dtype=jnp.float32`` to make
+        this sweep the lifecycle's single upcast (the packed optimizer
+        then reads fp32 straight from the same buffer — no
+        ``double_cast`` round-trip anywhere between backward and the
+        update).
+
+        With ``numerics=`` — a ``(NumericsMonitor, NumericsState)`` pair
+        whose monitor was built from the matching ``PackSpec`` — the
+        per-row flags become exact per-LEAF overflow provenance through
+        the row-aligned offsets (``observe(row_nonfinite=...)``), at
+        zero extra sweeps; returns ``(flat, new_state,
+        new_numerics_state)`` instead of the 2-tuple.
+        """
+        from ..ops.packed_optimizer import (
+            DEFAULT_CHUNK,
+            multi_tensor_scale_flat,
+        )
+
+        inv = 1.0 / state.loss_scale
+        out, found, row_bad = multi_tensor_scale_flat(
+            flat_grads, inv, out_dtype=out_dtype, per_row_flags=True,
+            chunk_size=chunk_size or DEFAULT_CHUNK,
+            use_kernel=use_kernel, interpret=interpret)
+        new_state = state._replace(found_inf=state.found_inf | found)
+        if numerics is None:
+            return out, new_state
+        monitor, nstate = numerics
+        nstate = monitor.observe(nstate, row_nonfinite=row_bad)
+        return out, new_state, nstate
+
+    def found_inf_flat(self, state: LossScaleState, flat_grads):
+        """Record overflow from flat SCALED gradients without unscaling
+        them — the read-only half of the fused one-sweep lifecycle.
+
+        The leanest spelling of the bucketed gradient lifecycle defers
+        the unscale multiply into the packed optimizer kernel
+        (``opt.step(..., grad_scale=state.loss_scale)`` — the kernels'
+        ``inv_scale`` operand), so all the scaler needs beforehand is the
+        overflow verdict: one read-only non-finite reduction, no write
+        sweep. The verdict is identical to :meth:`unscale_flat`'s while
+        ``scale >= 1`` — ``g`` and ``g / scale`` are then non-finite for
+        exactly the same inputs. Dynamic backoff can drive the scale
+        BELOW 1 (no ``min_loss_scale`` floor by default), where a
+        finite ``g`` CAN overflow under the deferred ``1/scale``
+        multiply — so the probe also flags ``|g| > fp32_max * scale``.
+        That term is identically false while ``scale >= 1`` (the
+        verdict-parity regime) and conservative below it: it prices the
+        ``1/scale`` multiply alone, so a fused step that also defers
+        the gradient average may skip a step the per-leaf reference
+        would have taken — a skipped step, never a poisoned one.
+
+        ``flat_grads`` is the reduced global buffer or the
+        ``BucketBuffers`` handoff (``reduce_flat(concat=False)``) — the
+        per-bucket form keeps this reduction off the concatenated
+        buffer, so the concat itself can stay fused inside the
+        optimizer's overflow-skip branch.
+        """
+        bufs = (flat_grads.buffers if hasattr(flat_grads, "buffers")
+                else (flat_grads,))
+        # fp32_max * scale: inf above scale 1 (comparison always false),
+        # fp32_max at exactly 1 — the term only fires collapsed-scale
+        lim = jnp.float32(jnp.finfo(jnp.float32).max) * jnp.asarray(
+            state.loss_scale, jnp.float32)
+        found = state.found_inf
+        for b in bufs:
+            # one fused predicate -> one reduction per buffer (a second
+            # jnp.any would double the sweep in XLA's cost model)
+            b32 = b.astype(jnp.float32)
+            found = found | jnp.any(~jnp.isfinite(b) | (jnp.abs(b32) > lim))
+        return state._replace(found_inf=found)
+
     def unscale_with_stashed(
         self, state: LossScaleState, new_scaled_grads: Pytree, stashed_grads: Pytree
     ) -> Tuple[Pytree, LossScaleState]:

@@ -496,6 +496,63 @@ def check_pack_spec(spec: PackSpec, *, shard_count: Optional[int] = None,
             f"leaf extents end at {end} > total {spec.total}",
             end=end, total=spec.total)
 
+    # bucketed layouts (GradBuckets): bucket boundaries must sit on chunk
+    # multiples (each bucket is a whole number of kernel chunks, so the
+    # per-bucket psum sub-buffers concatenate back into exactly the
+    # buffer the chunk-gridded optimizer kernels sweep) and the leaf
+    # ranges must partition the leaves in order
+    bounds = getattr(spec, "bucket_bounds", None)
+    ranges = getattr(spec, "bucket_leaf_ranges", None)
+    if bounds is not None:
+        if bounds[0] != 0 or bounds[-1] != spec.total:
+            err("bucket_bounds_cover",
+                f"bucket bounds {bounds[0]}..{bounds[-1]} do not cover "
+                f"[0, {spec.total})", first=bounds[0], last=bounds[-1],
+                total=spec.total)
+        prev = None
+        for b in bounds:
+            if b % spec.chunk_size:
+                err("bucket_not_chunk_aligned",
+                    f"bucket boundary {b} is not a multiple of chunk_size "
+                    f"{spec.chunk_size} — bucket sub-buffers straddle "
+                    "kernel chunks", boundary=b, chunk_size=spec.chunk_size)
+            if prev is not None and b <= prev:
+                err("bucket_bounds_not_increasing",
+                    f"bucket boundary {b} does not increase past {prev}",
+                    boundary=b, prev=prev)
+            prev = b
+        if ranges is not None:
+            # corrupt tables (truncated leaf tuples, a ranges/bounds
+            # length mismatch) must produce findings, not crash the
+            # walk — cap every index at what the tables actually hold
+            n_tab = min(spec.n_leaves, len(spec.offsets),
+                        len(spec.padded_sizes))
+            if len(ranges) != len(bounds) - 1:
+                err("bucket_tables_mismatch",
+                    f"{len(ranges)} bucket leaf ranges for "
+                    f"{len(bounds) - 1} buckets — the bucket tables "
+                    "disagree and per-bucket packing misattributes",
+                    n_ranges=len(ranges), n_buckets=len(bounds) - 1)
+            expect = 0
+            for bi, (lo, hi) in enumerate(ranges[:len(bounds) - 1]):
+                if lo != expect or hi < lo:
+                    err("bucket_leaves_not_partition",
+                        f"bucket {bi} leaf range ({lo}, {hi}) breaks the "
+                        f"in-order partition (expected start {expect})",
+                        bucket=bi, lo=lo, hi=hi)
+                for li in range(lo, min(hi, n_tab)):
+                    o, pn = spec.offsets[li], spec.padded_sizes[li]
+                    if o < bounds[bi] or o + pn > bounds[bi + 1]:
+                        err("leaf_outside_bucket",
+                            f"leaf {li} extent [{o}, {o + pn}) escapes "
+                            f"bucket {bi} bounds [{bounds[bi]}, "
+                            f"{bounds[bi + 1]})", leaf=li, bucket=bi)
+                expect = hi
+            if expect != spec.n_leaves:
+                err("bucket_leaves_not_partition",
+                    f"bucket leaf ranges end at {expect}, expected "
+                    f"{spec.n_leaves}", end=expect, n_leaves=spec.n_leaves)
+
     if shard_count:
         if spec.total % shard_count:
             err("shard_unaligned_total",

@@ -27,13 +27,29 @@ update rule), so norms over the padded buffer equal norms over the tree.
 """
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 Pytree = Any
+
+
+class BucketBuffers(NamedTuple):
+    """Per-bucket flat gradient buffers, NOT yet concatenated.
+
+    The handoff type of the bucketed allreduce
+    (``parallel.sync_gradients_bucketed(concat=False)``): each element is
+    one bucket's reduced flat buffer in the shared :class:`PackSpec`
+    layout. Passing this to a packed optimizer (``opt.step`` /
+    ``opt.step_flat``) defers the bucket concatenation INTO the update —
+    inside the overflow-skip ``lax.cond`` branch the concat has a single
+    elementwise consumer, so XLA fuses it into the update sweep's
+    gradient read instead of materializing the global buffer first.
+    """
+
+    buffers: Tuple[jax.Array, ...]
 
 # One fp32 vector register tile: 8 sublanes x 128 lanes. Leaf offsets are
 # aligned to this so (rows, ROW)-shaped kernel blocks never straddle a
@@ -58,10 +74,23 @@ class PackSpec:
     ``chunk_size`` is the kernel chunk contract: ``total`` is padded up to
     a multiple of it, so a grid of ``total // chunk_size`` fixed-size
     chunks tiles the buffer exactly (the CUDA chunking contract).
+
+    ``bucket_elems`` partitions the layout into contiguous chunk-aligned
+    *buckets* of at most that many elements (per-leaf, so one oversized
+    leaf still gets its own bucket) — the flat-buffer allreduce bucket
+    structure of the reference DDP (``apex/parallel/distributed.py``:
+    hook-discovered buckets, here sized up front by
+    ``GradBuckets(bucket_cap_mb=...)``). Each bucket's extent is a whole
+    number of chunks starting at a chunk-multiple offset, so bucket
+    sub-buffers slice out of (and concatenate back into) the global
+    buffer with no re-packing, and the SAME layout serves both the
+    per-bucket ``psum`` and the whole-buffer optimizer kernels. Without
+    ``bucket_elems`` the spec is one bucket covering everything.
     """
 
     def __init__(self, params_template: Pytree, align: int = ROW,
-                 chunk_size: int = DEFAULT_CHUNK):
+                 chunk_size: int = DEFAULT_CHUNK,
+                 bucket_elems: Optional[int] = None):
         if align % ROW:
             raise ValueError(f"align ({align}) must be a multiple of {ROW}")
         chunk_size = _round_up(int(chunk_size), align)
@@ -75,21 +104,51 @@ class PackSpec:
             jnp.dtype(l.dtype) for l in leaves)
         self.sizes: Tuple[int, ...] = tuple(
             int(np.prod(s)) if s else 1 for s in self.shapes)
-        self.padded_sizes: Tuple[int, ...] = tuple(
-            _round_up(n, align) for n in self.sizes)
-        offs = np.concatenate([[0], np.cumsum(self.padded_sizes)])
-        self.offsets: Tuple[int, ...] = tuple(int(o) for o in offs[:-1])
         self.n_leaves = len(leaves)
         self.align = align
         self.chunk_size = chunk_size
-        self.total = _round_up(int(offs[-1]), chunk_size)
+        self.bucket_elems = int(bucket_elems) if bucket_elems else None
+
+        # one walk lays out leaves and closes buckets: a bucket closes
+        # (offset rounds up to the next chunk boundary, absorbed into the
+        # previous leaf's padding) when the next leaf would overflow the
+        # per-bucket capacity and the bucket already holds a leaf
+        offsets, padded = [], []
+        end = 0
+        bounds = [0]
+        ranges = []
+        start_leaf = 0
+        for i, n in enumerate(self.sizes):
+            pn = _round_up(n, align)
+            if (self.bucket_elems and i > start_leaf
+                    and (end - bounds[-1]) + pn > self.bucket_elems):
+                b = _round_up(end, chunk_size)
+                padded[-1] += b - end
+                end = b
+                bounds.append(b)
+                ranges.append((start_leaf, i))
+                start_leaf = i
+            offsets.append(end)
+            padded.append(pn)
+            end += pn
+        self.total = _round_up(end, chunk_size)
+        bounds.append(self.total)
+        ranges.append((start_leaf, self.n_leaves))
+        self.offsets: Tuple[int, ...] = tuple(offsets)
+        self.padded_sizes: Tuple[int, ...] = tuple(padded)
+        self.bucket_bounds: Tuple[int, ...] = tuple(bounds)
+        self.bucket_leaf_ranges: Tuple[Tuple[int, int], ...] = tuple(ranges)
         self.n_rows = self.total // ROW
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.bucket_bounds) - 1
 
     # -- identity (jit static-arg / aux-data requirements) -----------------
     def _key(self):
         return (self.treedef, self.shapes,
                 tuple(str(d) for d in self.dtypes),
-                self.align, self.chunk_size)
+                self.align, self.chunk_size, self.bucket_elems)
 
     def __eq__(self, other):
         return isinstance(other, PackSpec) and self._key() == other._key()
@@ -99,7 +158,7 @@ class PackSpec:
 
     def __repr__(self):
         return (f"PackSpec(n_leaves={self.n_leaves}, total={self.total}, "
-                f"chunk_size={self.chunk_size})")
+                f"chunk_size={self.chunk_size}, n_buckets={self.n_buckets})")
 
     # -- dtype bookkeeping -------------------------------------------------
     def common_dtype(self, fallback=jnp.float32) -> np.dtype:
@@ -137,6 +196,55 @@ class PackSpec:
         if tail:
             pieces.append(jnp.zeros((tail,), dtype))
         return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    def pack_bucket(self, tree: Pytree, bucket: int,
+                    dtype: Optional[Any] = None) -> jax.Array:
+        """Ravel + zero-pad ONLY bucket ``bucket``'s leaves to its extent
+        (``bucket_bounds[b+1] - bucket_bounds[b]`` elements).
+
+        The bucketed sibling of :meth:`pack`: each bucket buffer depends
+        on nothing but its own leaves, so a per-bucket collective issued
+        on it can overlap the computation still producing other buckets'
+        gradients (XLA's latency-hiding scheduler owns the interleaving).
+        ``concat_buckets`` of all buckets equals :meth:`pack`.
+        """
+        self.check(tree)
+        dtype = jnp.dtype(dtype) if dtype is not None else self.common_dtype()
+        lo, hi = self.bucket_leaf_ranges[bucket]
+        leaves = jax.tree_util.tree_leaves(tree)[lo:hi]
+        pieces = []
+        used = 0
+        for leaf, n, pn in zip(leaves, self.sizes[lo:hi],
+                               self.padded_sizes[lo:hi]):
+            pieces.append(leaf.reshape(-1).astype(dtype))
+            if pn != n:
+                pieces.append(jnp.zeros((pn - n,), dtype))
+            used += pn
+        extent = self.bucket_bounds[bucket + 1] - self.bucket_bounds[bucket]
+        if extent != used:
+            pieces.append(jnp.zeros((extent - used,), dtype))
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    def bucket_slice(self, flat: jax.Array, bucket: int) -> jax.Array:
+        """Bucket ``bucket``'s sub-buffer of a packed global buffer."""
+        b0, b1 = self.bucket_bounds[bucket], self.bucket_bounds[bucket + 1]
+        return jax.lax.slice(flat, (b0,), (b1,))
+
+    def concat_buckets(self, buffers) -> jax.Array:
+        """Per-bucket buffers (in order) -> the ``(total,)`` global
+        buffer; the inverse of packing/slicing bucket-by-bucket."""
+        buffers = list(buffers)
+        if len(buffers) != self.n_buckets:
+            raise ValueError(
+                f"expected {self.n_buckets} bucket buffers, "
+                f"got {len(buffers)}")
+        for b, buf in enumerate(buffers):
+            extent = self.bucket_bounds[b + 1] - self.bucket_bounds[b]
+            if buf.shape != (extent,):
+                raise ValueError(
+                    f"bucket {b} buffer has shape {buf.shape}, "
+                    f"expected ({extent},)")
+        return buffers[0] if len(buffers) == 1 else jnp.concatenate(buffers)
 
     def unpack(self, flat: jax.Array, cast: bool = True) -> Pytree:
         """``(total,)`` -> pytree; each leaf cast back to its template

@@ -21,7 +21,11 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from ..multi_tensor_apply.packing import DEFAULT_CHUNK, PackSpec
+from ..multi_tensor_apply.packing import (
+    DEFAULT_CHUNK,
+    BucketBuffers,
+    PackSpec,
+)
 
 Pytree = Any
 
@@ -97,9 +101,28 @@ def packed_init(
     with_exp_avg_sq: bool = True,
     per_leaf_exp_avg_sq: bool = False,
     master_weights: bool = False,
+    spec: Optional[PackSpec] = None,
 ) -> PackedState:
-    """Build the flat-buffer state for ``params``."""
-    spec = PackSpec(params, chunk_size=chunk_size or DEFAULT_CHUNK)
+    """Build the flat-buffer state for ``params``.
+
+    ``spec=`` adopts an externally-built layout instead of deriving one —
+    the bucketed-gradient handoff: an optimizer initialised over
+    ``GradBuckets(params).spec`` steps DIRECTLY on the reduced flat
+    buffer the bucketed allreduce produces (``opt.step(flat_grads,
+    ...)``), no repacking between collective and update. The adopted
+    spec carries its own chunking, so an explicit conflicting
+    ``chunk_size`` is an error rather than a silent override.
+    """
+    if spec is not None:
+        if chunk_size is not None and chunk_size != spec.chunk_size:
+            raise ValueError(
+                f"chunk_size={chunk_size} conflicts with the adopted "
+                f"spec's chunk_size={spec.chunk_size} — the external "
+                "layout owns the kernel chunking; drop chunk_size or "
+                "build the spec (GradBuckets) with the one you want")
+        spec.check(params)  # same treedef/shapes or fail loudly
+    else:
+        spec = PackSpec(params, chunk_size=chunk_size or DEFAULT_CHUNK)
     if per_leaf_exp_avg_sq:
         exp_avg_sq = jnp.zeros((spec.n_leaves,), jnp.float32)
     elif with_exp_avg_sq:
@@ -126,6 +149,37 @@ def tree_common_dtype(tree: Pytree, fallback=jnp.float32):
     buffer must be homogeneous; unpack casts leaves back individually."""
     dtypes = {jnp.dtype(l.dtype) for l in jax.tree_util.tree_leaves(tree)}
     return dtypes.pop() if len(dtypes) == 1 else jnp.dtype(fallback)
+
+
+def as_flat_grads(grads, spec: PackSpec) -> jax.Array:
+    """``grads`` — a pytree, a pre-packed flat buffer in ``spec``
+    layout, or the :class:`BucketBuffers` handoff — as the packed flat
+    gradient buffer. The one dispatch point of the packed optimizers: a
+    1-D array of exactly ``spec.total`` elements is the reduced buffer
+    the bucketed allreduce hands over (any other 1-D length that is not
+    the spec's own single-leaf pytree is a layout mismatch, so it raises
+    rather than silently repacking a wrong-length buffer);
+    ``BucketBuffers`` (the ``concat=False`` handoff) concatenates lazily
+    HERE — inside the overflow-skip branch, where the concat fuses into
+    the update sweep's gradient read instead of materializing the global
+    buffer; anything else is packed via ``spec.pack``. A bare 1-D array
+    that IS a valid single-leaf grads pytree for this spec keeps the
+    pytree reading (packed, dtype-normalised) — the pre-change
+    behaviour."""
+    if isinstance(grads, BucketBuffers):
+        return spec.concat_buckets(grads.buffers)
+    if (isinstance(grads, jax.Array) and grads.ndim == 1
+            and not (spec.n_leaves == 1 and spec.shapes[0] == grads.shape
+                     and spec.treedef
+                     == jax.tree_util.tree_structure(grads))):
+        if grads.shape[0] != spec.total:
+            raise ValueError(
+                f"flat gradient buffer has {grads.shape[0]} elements but "
+                f"the optimizer's PackSpec lays out {spec.total} — build "
+                "the optimizer over the SAME spec as the gradient buckets "
+                "(packed_spec=buckets.spec)")
+        return grads
+    return spec.pack(grads, tree_common_dtype(grads))
 
 
 def packed_src(state: PackedState, params: Pytree,

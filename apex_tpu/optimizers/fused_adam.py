@@ -44,7 +44,12 @@ from ._common import (
     tree_f32,
     tree_zeros_like,
 )
-from ._packed import PackedState, packed_init, packed_src, tree_common_dtype
+from ._packed import (
+    PackedState,
+    as_flat_grads,
+    packed_init,
+    packed_src,
+)
 
 
 class FusedAdamState(NamedTuple):
@@ -70,6 +75,7 @@ class FusedAdam(FusedOptimizer):
         packed: bool = False,
         packed_chunk_size: Optional[int] = None,
         packed_interpret: bool = False,
+        packed_spec=None,
     ):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
@@ -83,6 +89,11 @@ class FusedAdam(FusedOptimizer):
         self.packed = packed
         self.packed_chunk_size = packed_chunk_size
         self.packed_interpret = packed_interpret
+        # external layout adoption (GradBuckets.spec): step() then takes
+        # the reduced flat gradient buffer directly
+        self.packed_spec = packed_spec
+        if packed_spec is not None and not packed:
+            raise ValueError("packed_spec requires packed=True")
 
     def init(self, params: Pytree):
         if self.packed:
@@ -90,6 +101,7 @@ class FusedAdam(FusedOptimizer):
                 params,
                 chunk_size=self.packed_chunk_size,
                 master_weights=self.master_weights,
+                spec=self.packed_spec,
             )
         return FusedAdamState(
             step=jnp.int32(0),
@@ -159,7 +171,10 @@ class FusedAdam(FusedOptimizer):
         beta1, beta2 = self.betas
         new_step = state.step + 1
         bc1, bc2 = self._bias_corrections(new_step)
-        flat_g = spec.pack(grads, tree_common_dtype(grads))
+        # grads may arrive PRE-PACKED (the bucketed-allreduce handoff:
+        # the reduced flat buffer in this state's own spec layout) — the
+        # packing sweep then disappears entirely
+        flat_g = as_flat_grads(grads, spec)
         # opt-in activation-watch tap on the packed grad buffer: identity
         # (no trace difference) unless a numerics.activation_watch is
         # active; then one extra row-stats sweep names non-finite leaves
@@ -190,7 +205,17 @@ class FusedAdam(FusedOptimizer):
             chunk_size=spec.chunk_size,
             interpret=self.packed_interpret,
         )
-        new_params = spec.unpack(p_out)
+        # off-TPU, unpack the new params from the fp32 MASTER buffer
+        # when one exists: identical values (p_out is recast(master)),
+        # but slicing a bf16 buffer on XLA CPU/GPU pays a whole-buffer
+        # f32-emulation convert chain PER LEAF, which both the cost
+        # model and the runtime bill. On TPU bf16 slices are native and
+        # the half-width p_out read is the cheaper source.
+        unpack_src = p_out
+        if master is not None and jax.default_backend() != "tpu" \
+                and jnp.dtype(spec.common_dtype()) == jnp.bfloat16:
+            unpack_src = master
+        new_params = spec.unpack(unpack_src)
         if not write_mv:
             return new_params, state
         new_state = PackedState(
@@ -221,6 +246,98 @@ class FusedAdam(FusedOptimizer):
             found_inf,
             lambda: stepped(grads, state, params, lr, wd, inv_scale),
             (params, state),
+        )
+
+    def step_flat(
+        self,
+        grads,
+        state: PackedState,
+        lr: Optional[jax.Array] = None,
+        weight_decay: Optional[float] = None,
+        found_inf: Optional[jax.Array] = None,
+        grad_scale=None,
+    ) -> PackedState:
+        """Flat-carry step: reduced gradient buffer in, new STATE out.
+
+        The endpoint of the bucketed gradient lifecycle, in which the
+        fp32 master buffer IS the parameter store (apex O2 semantics
+        taken literally): the forward takes its leaf views from
+        ``state.master_params`` via ``spec.unpack`` (the reference DDP's
+        flat-buffer-with-views design), ``grads`` is the reduced flat
+        buffer or the ``BucketBuffers`` handoff, and nothing is ever
+        unpacked or re-packed between the collective and the update:
+
+            bufs, _ = ddp.reduce_flat(grads, buckets=buckets, concat=False)
+            sstate = scaler.found_inf_flat(sstate, bufs)
+            opt_state = opt.step_flat(bufs, opt_state,
+                                      found_inf=sstate.found_inf,
+                                      grad_scale=sstate.loss_scale)
+            # next forward: buckets.unpack(opt_state.master_params)
+
+        Two deliberate departures from :meth:`step`:
+
+        - overflow skip uses the kernels' IN-SWEEP ``noop`` flag (the
+          CUDA ``noop_flag`` contract) instead of a ``lax.cond`` around
+          the update — a fused select costs nothing extra and, unlike a
+          cond, never breaks XLA's in-place aliasing of the donated
+          state buffers (a cond boundary forces defensive copies of
+          every carried buffer on some backends);
+        - the unscale multiply rides ``grad_scale`` into the kernel's
+          ``inv_scale`` operand, so deferred scalings (loss scale, and a
+          deferred gradient average — fold ``world`` into ``grad_scale``
+          when both are powers of two and the division commutes
+          bit-exactly) all collapse into the sweep's one multiply.
+
+        Requires ``packed=True`` with ``master_weights=True``.
+        """
+        if not (self.packed and self.master_weights):
+            raise ValueError(
+                "step_flat requires packed=True and master_weights=True "
+                "(the fp32 update source must live in the optimizer state)")
+        lr = self.lr if lr is None else lr
+        wd = self.weight_decay if weight_decay is None else weight_decay
+        inv_scale = resolve_scale(grad_scale)
+        spec = state.spec
+        beta1, beta2 = self.betas
+        has_noop = found_inf is not None
+        stepped = state.step + 1
+        bc1, bc2 = self._bias_corrections(stepped)
+        flat_g = as_flat_grads(grads, spec)
+        flat_g = _numerics.tap_flat(
+            "apex_tpu.packed_adam/grads", flat_g, spec=spec,
+            inv_scale=inv_scale, interpret=self.packed_interpret)
+        _, ms, vs, master = packed_adam_apply(
+            flat_g,
+            state.exp_avg,
+            state.exp_avg_sq,
+            state.master_params,
+            param_dtype=spec.common_dtype(),
+            lr=jnp.asarray(lr, jnp.float32),
+            bc1=bc1,
+            bc2=bc2,
+            inv_scale=inv_scale,
+            noop=found_inf if has_noop else None,
+            beta1=beta1,
+            beta2=beta2,
+            eps=self.eps,
+            wd=wd,
+            adam_w_mode=self.adam_w_mode,
+            write_mv=True,
+            write_master=True,
+            chunk_size=spec.chunk_size,
+            interpret=self.packed_interpret,
+        )
+        if has_noop:
+            # the noop contract covers the step counter too: a skipped
+            # step must not advance bias correction
+            stepped = jnp.where(jnp.asarray(found_inf, jnp.bool_),
+                                state.step, stepped)
+        return PackedState(
+            step=stepped,
+            exp_avg=ms,
+            exp_avg_sq=vs,
+            master_params=master,
+            spec=spec,
         )
 
     def no_update_mv_step(

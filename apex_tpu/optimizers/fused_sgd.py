@@ -25,7 +25,12 @@ from ._common import (
     tree_f32,
     tree_zeros_like,
 )
-from ._packed import PackedState, packed_init, packed_src, tree_common_dtype
+from ._packed import (
+    PackedState,
+    as_flat_grads,
+    packed_init,
+    packed_src,
+)
 
 
 class FusedSGDState(NamedTuple):
@@ -49,6 +54,7 @@ class FusedSGD(FusedOptimizer):
         packed: bool = False,
         packed_chunk_size: Optional[int] = None,
         packed_interpret: bool = False,
+        packed_spec=None,
     ):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero dampening")
@@ -62,6 +68,9 @@ class FusedSGD(FusedOptimizer):
         self.packed = packed
         self.packed_chunk_size = packed_chunk_size
         self.packed_interpret = packed_interpret
+        self.packed_spec = packed_spec
+        if packed_spec is not None and not packed:
+            raise ValueError("packed_spec requires packed=True")
 
     def init(self, params: Pytree):
         if self.packed:
@@ -71,6 +80,7 @@ class FusedSGD(FusedOptimizer):
                 chunk_size=self.packed_chunk_size,
                 with_exp_avg_sq=False,
                 master_weights=self.master_weights,
+                spec=self.packed_spec,
             )
         return FusedSGDState(
             step=jnp.int32(0),
@@ -115,7 +125,9 @@ class FusedSGD(FusedOptimizer):
                         inv_scale):
         """One fused chunked sweep (``multi_tensor_sgd_kernel.cu``)."""
         spec = state.spec
-        flat_g = spec.pack(grads, tree_common_dtype(grads))
+        # pre-packed flat grads (the bucketed-allreduce handoff) skip
+        # the packing sweep — see fused_adam._packed_stepped
+        flat_g = as_flat_grads(grads, spec)
         p_out, bufs, master = packed_sgd_apply(
             flat_g,
             state.exp_avg,

@@ -3,10 +3,13 @@
 TPU-native re-design of ``apex/parallel/__init__.py:9-21``.
 """
 from .distributed import (  # noqa: F401
+    BucketBuffers,
     DistributedDataParallel,
+    GradBuckets,
     Reducer,
     flatten,
     sync_gradients,
+    sync_gradients_bucketed,
     unflatten,
 )
 from .LARC import LARC, larc_adjust_gradients, larc_transform  # noqa: F401

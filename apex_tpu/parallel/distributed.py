@@ -24,6 +24,24 @@ named mesh axis:
                            allreduce_always_fp32=False,
                            gradient_predivide_factor=1.0)
 
+What ALSO survives — the reference's signature speed trick — is the
+flat-buffer bucket structure itself. :class:`GradBuckets` packs the
+gradient pytree into K chunk-aligned buckets of one contiguous layout
+(``multi_tensor_apply.packing.PackSpec`` with ``bucket_elems``, sized by
+``bucket_cap_mb``), each bucket is reduced by ONE ``lax.psum`` on its
+flat sub-buffer (under an ``apex_tpu.grad_bucket/<i>`` named scope so
+xplane breakdowns can attribute — and prove the overlap of — each
+bucket's collective), and the reduced global buffer feeds the packed
+optimizer kernels *directly*: unscale + ``found_inf`` + the optimizer
+update + master recast all sweep the same buffer
+(``amp.LossScaler.unscale_flat`` -> ``FusedAdam(packed=True,
+packed_spec=buckets.spec)``), one HBM sweep from reduced gradients to
+updated params — on 1 device or N. Because each bucket buffer depends
+only on its own leaves, XLA's latency-hiding scheduler is free to issue
+early buckets' collectives while the rest of backward still computes —
+the compiler-scheduled form of the reference's hook-driven overlap
+(see ``docs/distributed.md`` for the honest version of that claim).
+
 Options mirror the reference constructor (``distributed.py:164-177``):
 
 - ``gradient_average``            divide by world size (reference ``:209``)
@@ -43,11 +61,18 @@ the functional spelling of the same contract. ``Reducer``
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.flatten_util
 import jax.numpy as jnp
+
+from ..multi_tensor_apply.packing import (
+    DEFAULT_CHUNK,
+    ROW,
+    BucketBuffers,
+    PackSpec,
+)
 
 Pytree = Any
 
@@ -75,6 +100,30 @@ def unflatten(flat: jax.Array, tree: Pytree) -> Pytree:
     return jax.flatten_util.ravel_pytree(tree)[1](flat)
 
 
+def _reduce_buffer(
+    g: jax.Array,
+    axis_name: str,
+    world,
+    *,
+    gradient_average: bool,
+    gradient_predivide_factor: float,
+):
+    """The reference ``allreduce_bucket`` arithmetic on ONE buffer (leaf
+    or flat bucket), casts excluded: optional pre-division before the
+    reduction, mean/sum semantics with the pre/post split after it
+    (``apex/parallel/distributed.py:429-479``). Shared verbatim by the
+    per-leaf and bucketed paths so the two are bit-identical elementwise.
+    """
+    if gradient_predivide_factor != 1.0:
+        g = g / gradient_predivide_factor
+    g = jax.lax.psum(g, axis_name)
+    if gradient_average:
+        g = g / (world / gradient_predivide_factor)
+    elif gradient_predivide_factor != 1.0:
+        g = g * gradient_predivide_factor
+    return g
+
+
 @jax.named_scope("apex_tpu.sync_gradients")
 def sync_gradients(
     grads: Pytree,
@@ -83,6 +132,7 @@ def sync_gradients(
     gradient_average: bool = True,
     allreduce_always_fp32: bool = False,
     gradient_predivide_factor: float = 1.0,
+    keep_fp32: bool = False,
 ) -> Pytree:
     """All-reduce a gradient pytree over the ``axis_name`` mesh axis.
 
@@ -91,6 +141,17 @@ def sync_gradients(
     pre-division before the reduction and post-division after it, mean or sum
     semantics. Must be called inside ``shard_map``/``pmap`` that binds
     ``axis_name``.
+
+    ``keep_fp32=True`` keeps the reduced gradients in fp32 when
+    ``allreduce_always_fp32`` upcast them, instead of casting back to the
+    leaf dtype. The default ``False`` is reference parity (``:466``:
+    "bucket -> half, copy into model grads") — but in a step whose next
+    consumer upcasts again (every fused optimizer, the amp unscale) that
+    round-trip is the ``double_cast`` pattern the PR-4 auditor flags:
+    the second cast cannot restore the mantissa bits the first dropped,
+    and both casts pay a full convert sweep. Pass ``keep_fp32=True``
+    there (audit-clean); the legacy default survives for callers that
+    hand grads to dtype-strict consumers.
     """
     world = jax.lax.psum(1, axis_name)
 
@@ -98,16 +159,178 @@ def sync_gradients(
         orig_dtype = g.dtype
         if allreduce_always_fp32:
             g = g.astype(jnp.float32)
-        if gradient_predivide_factor != 1.0:
-            g = g / gradient_predivide_factor
-        g = jax.lax.psum(g, axis_name)
-        if gradient_average:
-            g = g / (world / gradient_predivide_factor)
-        elif gradient_predivide_factor != 1.0:
-            g = g * gradient_predivide_factor
+        g = _reduce_buffer(
+            g, axis_name, world,
+            gradient_average=gradient_average,
+            gradient_predivide_factor=gradient_predivide_factor)
+        if keep_fp32:
+            return g
+        # waiver note: this downcast is the documented reference-parity
+        # behaviour; audit-clean steps use keep_fp32=True or the
+        # bucketed flat path (one cast per bucket, no round-trip)
         return g.astype(orig_dtype)
 
     return jax.tree_util.tree_map(_reduce, grads)
+
+
+class GradBuckets:
+    """Static bucket structure for the flat-buffer gradient lifecycle.
+
+    The reference DDP discovers buckets from hook firing order on the
+    first backward (``apex/parallel/distributed.py:340-427``); under XLA
+    the gradient pytree is known at trace time, so the buckets are laid
+    out up front: leaves in flatten order, greedily filled to
+    ``bucket_cap_mb`` (measured in ``reduce_dtype`` — pass
+    ``reduce_dtype=jnp.float32`` when the reduction runs at fp32
+    (``allreduce_always_fp32``) so the cap prices the buffers the
+    collective actually moves; one oversized leaf still gets its own
+    bucket, like the reference's ``message_size`` overflow), each
+    bucket a chunk-aligned contiguous
+    range of ONE global :class:`PackSpec` layout. That single layout is
+    the load-bearing trick: the per-bucket psum sub-buffers concatenate
+    straight into the buffer the packed optimizer kernels sweep — no
+    second packing between reduction and update.
+
+    ``spec`` is shared with the optimizer
+    (``FusedAdam(packed=True, packed_spec=buckets.spec)``) so the
+    reduced buffer feeds ``opt.step`` directly.
+    """
+
+    def __init__(self, template: Pytree, *, bucket_cap_mb: float = 25.0,
+                 align: int = ROW, chunk_size: int = DEFAULT_CHUNK,
+                 reduce_dtype=None):
+        if bucket_cap_mb <= 0:
+            raise ValueError(
+                f"bucket_cap_mb must be > 0, got {bucket_cap_mb}")
+        leaves = jax.tree_util.tree_leaves(template)
+        if not leaves:
+            raise ValueError("cannot bucket an empty gradient pytree")
+        dtypes = {jnp.dtype(l.dtype) for l in leaves}
+        self.grad_dtype = (dtypes.pop() if len(dtypes) == 1
+                           else jnp.dtype(jnp.float32))
+        self.reduce_dtype = (jnp.dtype(reduce_dtype) if reduce_dtype
+                             is not None else self.grad_dtype)
+        itemsize = jnp.dtype(self.reduce_dtype).itemsize
+        self.bucket_cap_mb = float(bucket_cap_mb)
+        cap_elems = max(int(bucket_cap_mb * 2 ** 20) // itemsize, 1)
+        self.spec = PackSpec(template, align=align, chunk_size=chunk_size,
+                             bucket_elems=cap_elems)
+
+    @property
+    def n_buckets(self) -> int:
+        return self.spec.n_buckets
+
+    def pack(self, grads: Pytree, dtype=None) -> List[jax.Array]:
+        """K per-bucket flat buffers (each depending only on its own
+        leaves — the property that lets XLA overlap early buckets'
+        collectives with the rest of backward)."""
+        dtype = dtype if dtype is not None else self.reduce_dtype
+        return [self.spec.pack_bucket(grads, b, dtype)
+                for b in range(self.n_buckets)]
+
+    def concat(self, buffers) -> jax.Array:
+        return self.spec.concat_buckets(buffers)
+
+    def unpack(self, flat: jax.Array) -> Pytree:
+        return self.spec.unpack(flat)
+
+    def sweep_bytes(self) -> int:
+        """Minimum algorithmic HBM traffic of one bucketed reduction, in
+        bytes: read every gradient leaf + write the packed buffers, plus
+        the collective's read+write of the reduced buckets — the
+        telemetry denominator for achieved GB/s per drain, mirroring
+        :meth:`~apex_tpu.optimizers._packed.PackedState.sweep_bytes`
+        (``telemetry.drain(..., bytes_per_step=buckets.sweep_bytes() +
+        state.sweep_bytes())``). Counted at the chunk-padded length like
+        the kernels sweep it; inter-device link traffic is not modelled
+        (that is the xplane capture's job), so derived GB/s is
+        conservative.
+        """
+        itemsize = jnp.dtype(self.reduce_dtype).itemsize
+        # pack: read grads (grad dtype) + write buckets (reduce dtype);
+        # reduce: read + write each bucket buffer once locally
+        total = self.spec.total
+        return int(jnp.dtype(self.grad_dtype).itemsize * total
+                   + 3 * itemsize * total)
+
+    def check(self) -> None:
+        """Raise if the bucketed layout violates a PackSpec invariant
+        (``analysis.check_pack_spec``: ROW/chunk alignment, non-overlap,
+        chunk-aligned bucket bounds, in-order leaf partition)."""
+        from ..analysis import check_pack_spec
+
+        findings = check_pack_spec(self.spec, where=repr(self))
+        if findings:
+            raise ValueError(
+                "GradBuckets layout violates packing invariants:\n"
+                + "\n".join(f"- {f.code}: {f.message}" for f in findings))
+
+    def __repr__(self):
+        return (f"GradBuckets(n_buckets={self.n_buckets}, "
+                f"total={self.spec.total}, "
+                f"bucket_cap_mb={self.bucket_cap_mb})")
+
+
+def sync_gradients_bucketed(
+    grads: Pytree,
+    axis_name: str = "data",
+    *,
+    buckets: Optional[GradBuckets] = None,
+    bucket_cap_mb: float = 25.0,
+    gradient_average: bool = True,
+    allreduce_always_fp32: bool = False,
+    gradient_predivide_factor: float = 1.0,
+    match_leaf_dtype: bool = False,
+    concat: bool = True,
+) -> Tuple[Any, GradBuckets]:
+    """Bucketed flat-buffer allreduce: the reference's
+    ``allreduce_fallback``/``flat_dist_call`` path
+    (``apex/parallel/distributed.py:282-305``), K ``psum``-per-bucket
+    instead of one per leaf.
+
+    Packs ``grads`` into ``buckets`` (built from the grads structure
+    when not supplied), reduces each bucket's flat buffer with ONE
+    ``lax.psum`` under an ``apex_tpu.grad_bucket/<i>`` named scope, and
+    returns ``(flat, buckets)`` where ``flat`` is the reduced GLOBAL
+    buffer in ``buckets.spec`` layout — feed it straight to
+    ``LossScaler.unscale_flat`` and a packed optimizer built over the
+    same spec. ``allreduce_always_fp32`` casts each bucket up ONCE at
+    pack time (not per leaf); the result then *stays* fp32 unless
+    ``match_leaf_dtype=True`` asks for the reference's cast-back-to-half
+    parity (one downcast per bucket — the per-leaf oracle's semantics,
+    see ``tests/test_grad_lifecycle.py``).
+
+    ``concat=False`` skips the global concatenation and returns the
+    per-bucket buffers as :class:`BucketBuffers` — the leanest handoff:
+    the packed optimizers concatenate lazily inside their overflow-skip
+    branch, where the concat fuses into the update sweep's gradient read
+    instead of materializing the global buffer (and
+    ``LossScaler.found_inf_flat`` reads the buckets directly).
+    """
+    if buckets is None:
+        # size the cap in the dtype the collective actually moves: an
+        # fp32 reduction of bf16 grads would otherwise ship 2x
+        # bucket_cap_mb per psum (callers building their own buckets
+        # for the fp32 path should pass reduce_dtype=jnp.float32 too)
+        buckets = GradBuckets(
+            grads, bucket_cap_mb=bucket_cap_mb,
+            reduce_dtype=jnp.float32 if allreduce_always_fp32 else None)
+    world = jax.lax.psum(1, axis_name)
+    reduce_dtype = (jnp.dtype(jnp.float32) if allreduce_always_fp32
+                    else buckets.reduce_dtype)
+    out = []
+    for i, buf in enumerate(buckets.pack(grads, reduce_dtype)):
+        with jax.named_scope(f"apex_tpu.grad_bucket/{i}"):
+            red = _reduce_buffer(
+                buf, axis_name, world,
+                gradient_average=gradient_average,
+                gradient_predivide_factor=gradient_predivide_factor)
+            if match_leaf_dtype:
+                red = red.astype(buckets.grad_dtype)
+            out.append(red)
+    if not concat:
+        return BucketBuffers(tuple(out)), buckets
+    return buckets.concat(out), buckets
 
 
 class Reducer:
@@ -138,10 +361,27 @@ class DistributedDataParallel:
         # inside shard_map over the 'data' axis:
         grads = grad_fn(params, batch)      # already allreduced
 
+    With ``bucket_cap_mb`` set, ``sync``/``wrap_grad_fn`` run the
+    flat-buffer bucketed reduction (one psum per bucket instead of one
+    per leaf) and :meth:`reduce_flat` exposes the reduced GLOBAL flat
+    buffer for the full packed lifecycle — unscale + found_inf +
+    optimizer update on the same buffer:
+
+        buckets = GradBuckets(params, bucket_cap_mb=25)
+        ddp = DistributedDataParallel(axis_name="data", bucket_cap_mb=25)
+        opt = FusedAdam(packed=True, packed_spec=buckets.spec, ...)
+        # inside the jitted shard_map step:
+        flat, _ = ddp.reduce_flat(grads, buckets=buckets)
+        flat, sstate = scaler.unscale_flat(sstate, flat,
+                                           out_dtype=jnp.float32)
+        params, opt_state = opt.step(flat, opt_state, params,
+                                     found_inf=sstate.found_inf)
+
     ``message_size``, ``num_allreduce_streams``, ``allreduce_trigger_params``
     and ``retain_allreduce_buffers`` (reference ``:164-177``) configure
-    hook/bucket mechanics with no XLA analogue; they are accepted for API
-    parity and ignored (XLA's collective combiner owns bucketing).
+    hook/stream mechanics with no XLA analogue; they are accepted for API
+    parity and ignored (``bucket_cap_mb`` is the surviving bucket knob —
+    XLA's scheduler owns the overlap, the layout here owns the buckets).
     """
 
     def __init__(
@@ -156,6 +396,7 @@ class DistributedDataParallel:
         num_allreduce_streams: int = 1,
         gradient_average: bool = True,
         gradient_predivide_factor: float = 1.0,
+        bucket_cap_mb: Optional[float] = None,
     ):
         del message_size, delay_allreduce, shared_param  # XLA-owned mechanics
         del allreduce_trigger_params, retain_allreduce_buffers
@@ -164,8 +405,40 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
+        self.bucket_cap_mb = bucket_cap_mb
+
+    def reduce_flat(
+        self,
+        grads: Pytree,
+        buckets: Optional[GradBuckets] = None,
+        *,
+        match_leaf_dtype: bool = False,
+        concat: bool = True,
+    ) -> Tuple[Any, GradBuckets]:
+        """Bucketed allreduce -> the reduced global flat buffer (see
+        :func:`sync_gradients_bucketed`; ``concat=False`` returns the
+        per-bucket :class:`BucketBuffers` handoff instead). Pass the
+        ``buckets`` shared with the packed optimizer; built from the
+        grads structure when omitted (trace-time bookkeeping, no runtime
+        cost)."""
+        return sync_gradients_bucketed(
+            grads,
+            self.axis_name,
+            buckets=buckets,
+            bucket_cap_mb=self.bucket_cap_mb or 25.0,
+            gradient_average=self.gradient_average,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            match_leaf_dtype=match_leaf_dtype,
+            concat=concat,
+        )
 
     def sync(self, grads: Pytree) -> Pytree:
+        if self.bucket_cap_mb:
+            # pytree-in/pytree-out spelling of the bucketed path: K
+            # collectives, leaf dtypes preserved (cast once per bucket)
+            flat, buckets = self.reduce_flat(grads, match_leaf_dtype=True)
+            return buckets.unpack(flat)
         return sync_gradients(
             grads,
             self.axis_name,
@@ -174,7 +447,9 @@ class DistributedDataParallel:
             gradient_predivide_factor=self.gradient_predivide_factor,
         )
 
-    def wrap_grad_fn(self, grad_fn: Callable, has_value: bool = False) -> Callable:
+    def wrap_grad_fn(self, grad_fn: Callable, has_value: bool = False,
+                     flat: bool = False,
+                     buckets: Optional[GradBuckets] = None) -> Callable:
         """Wrap a gradient function so its gradients come out synced.
 
         ``has_value=True`` declares the ``jax.value_and_grad`` convention —
@@ -183,14 +458,34 @@ class DistributedDataParallel:
         pytree (this also covers ``argnums`` tuples, which are pytrees of
         grads). The flag is explicit rather than guessed from tuple shape
         so a ``has_aux`` output can never be mistaken for grads.
+
+        ``flat=True`` returns the REDUCED GLOBAL FLAT BUFFER instead of a
+        pytree (``buckets.spec`` layout) — the zero-copy handoff into
+        ``unscale_flat`` + the packed optimizer step. ``buckets`` is
+        required there: an auto-built layout would be discarded with
+        the wrapper's return, leaving the caller a buffer whose layout
+        nothing else shares (a separately built GradBuckets can differ
+        in bounds and padding).
         """
+        if flat and buckets is None:
+            raise ValueError(
+                "wrap_grad_fn(flat=True) requires buckets= — the flat "
+                "buffer is only interpretable through the SAME "
+                "GradBuckets the packed optimizer was built over "
+                "(packed_spec=buckets.spec)")
+
+        def _out(grads):
+            if flat:
+                return self.reduce_flat(grads, buckets=buckets)[0]
+            return self.sync(grads)
+
         @functools.wraps(grad_fn)
         def wrapped(*args, **kwargs):
             out = grad_fn(*args, **kwargs)
             if has_value:
                 value, grads = out
-                return value, self.sync(grads)
-            return self.sync(out)
+                return value, _out(grads)
+            return _out(out)
 
         return wrapped
 

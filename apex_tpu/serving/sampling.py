@@ -39,7 +39,11 @@ one argmax, no cumsum inversion, and the filtered tokens simply sit at
 ``-inf``. ``temperature == 0`` (the default) is greedy argmax,
 **bit-identical to the pre-sampling engine**: the whole sampling branch
 sits behind a ``lax.cond`` on ``any(temperature > 0)``, so pure-greedy
-traffic never pays the per-step vocab sort.
+traffic never pays the filter at all. Sampling traffic resolves its
+filter thresholds from a ``lax.top_k(TOP_FILTER_WIDTH)`` prefix instead
+of a full ``[R, vocab]`` sort (same filter semantics; a second
+``lax.cond`` falls back to the full sort only when a row's thresholds
+genuinely live beyond the prefix — see :func:`_thresholds`).
 """
 from __future__ import annotations
 
@@ -122,32 +126,89 @@ def uniform_from_hash(seeds: jax.Array, rids: jax.Array,
             / jnp.float32(1 << 24))
 
 
-def _filtered_logits(logits: jax.Array, temps: jax.Array,
-                     top_ks: jax.Array, top_ps: jax.Array) -> jax.Array:
-    """Temperature-scaled logits with the top-k then top-p filters
-    applied as ``-inf`` masks ([R, V] -> [R, V]; row-independent, so a
-    batch row matches the [1, V] reference exactly)."""
-    R, V = logits.shape
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    srt = -jnp.sort(-scaled, axis=-1)                       # descending
-    # top-k: the k-th largest logit is the keep threshold (k = 0 or
-    # k >= V keeps everything)
-    k_eff = jnp.where(top_ks <= 0, V,
-                      jnp.clip(top_ks, 1, V)).astype(jnp.int32)
-    kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=1)
-    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+#: static width of the ``lax.top_k`` prefilter: the filter thresholds
+#: resolve from the ``TOP_FILTER_WIDTH`` largest logits per row whenever
+#: every row's ``top_k <= width`` (or is disabled) and the top-``width``
+#: tokens already carry ``top_p`` mass — i.e. essentially always for real
+#: sampling configs. A ``lax.cond`` falls back to the full-vocab sort
+#: only when some row genuinely needs deeper thresholds, so the common
+#: decode step pays O(V·log width) selection instead of a full [R, V]
+#: vocab sort.
+TOP_FILTER_WIDTH = 64
+
+
+def _thresholds(vals_desc: jax.Array, scaled: jax.Array,
+                top_ks: jax.Array, top_ps: jax.Array):
+    """Per-row keep thresholds from a DESCENDING prefix ``vals_desc``
+    ([R, W], W <= V) of each row of ``scaled`` ([R, V]).
+
+    Returns ``(kth, thresh, covered)``: the top-k threshold, the top-p
+    threshold, and whether the prefix was deep enough for this row's
+    filters to be exact. Every reduction that is not over the sorted
+    prefix itself (the softmax denominator) runs over the UNSORTED full
+    vocab, and a cumsum's first W partials depend only on its first W
+    inputs — so the thresholds are bitwise identical whether computed
+    from a ``lax.top_k`` prefix or the full sort, and a batch may take
+    either path without breaking per-row byte identity.
+    """
+    R, V = scaled.shape
+    W = vals_desc.shape[1]
+    # top-k: the k-th largest logit is the keep threshold; k = 0
+    # (disabled) and k >= V keep everything — a -inf threshold yields
+    # the identical mask, with no need for the V-th largest value
+    k_idx = jnp.clip(top_ks, 1, W).astype(jnp.int32) - 1
+    kth = jnp.take_along_axis(vals_desc, k_idx[:, None], axis=1)[:, 0]
+    k_all = (top_ks <= 0) | (top_ks >= V)
+    kth = jnp.where(k_all, -jnp.inf, kth)
     # top-p over the top-k survivors: keep sorted tokens whose
-    # cumulative mass BEFORE them is < p (always keeps the argmax).
-    # The sorted view of `masked` is derivable from the ONE sort above
-    # (the kept entries are exactly a prefix of the descending `srt`),
-    # so the vocab is sorted once, not twice.
-    msrt = jnp.where(srt >= kth, srt, -jnp.inf)
-    probs = jax.nn.softmax(msrt, axis=-1)
+    # cumulative mass BEFORE them is < p (always keeps the argmax)
+    m = vals_desc[:, 0]
+    denom = jnp.sum(
+        jnp.where(scaled >= kth[:, None],
+                  jnp.exp(scaled - m[:, None]), 0.0), axis=-1)
+    ms = jnp.where(vals_desc >= kth[:, None], vals_desc, -jnp.inf)
+    probs = jnp.exp(ms - m[:, None]) / denom[:, None]  # -inf -> 0
     cum = jnp.cumsum(probs, axis=-1)
     n_keep = jnp.sum((cum - probs) < top_ps[:, None],
                      axis=-1).astype(jnp.int32)
-    thresh = jnp.take_along_axis(msrt, (n_keep - 1)[:, None], axis=1)
-    return jnp.where(masked >= thresh, masked, -jnp.inf)
+    thresh = jnp.take_along_axis(
+        ms, jnp.maximum(n_keep - 1, 0)[:, None], axis=1)[:, 0]
+    thresh = jnp.where(top_ps >= 1.0, -jnp.inf, thresh)
+    p_done = (top_ps >= 1.0) | (cum[:, -1] >= top_ps)
+    covered = (k_all | (top_ks <= W)) & p_done
+    return kth, thresh, covered
+
+
+def _filtered_logits(logits: jax.Array, temps: jax.Array,
+                     top_ks: jax.Array, top_ps: jax.Array,
+                     width: int = TOP_FILTER_WIDTH) -> jax.Array:
+    """Temperature-scaled logits with the top-k then top-p filters
+    applied as ``-inf`` masks ([R, V] -> [R, V]; row-independent, so a
+    batch row matches the [1, V] reference exactly — both paths of the
+    prefilter produce bitwise-identical thresholds, see
+    :func:`_thresholds`)."""
+    R, V = logits.shape
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    W = min(int(width), V)
+    kth, thresh, covered = _thresholds(
+        jax.lax.top_k(scaled, W)[0], scaled, top_ks, top_ps)
+
+    def deep(_):
+        # some row's thresholds live beyond the prefix: pay the full
+        # descending sort once for the whole batch (the pre-prefilter
+        # lowering). Rows the prefix DID cover keep their prefix-path
+        # thresholds — not merely equal-by-math but the SAME values, so
+        # a row's bits can never depend on batch composition even where
+        # a backend's cumsum bracketing varies with the scanned length
+        srt = -jnp.sort(-scaled, axis=-1)
+        f_kth, f_thresh, _ = _thresholds(srt, scaled, top_ks, top_ps)
+        return (jnp.where(covered, kth, f_kth),
+                jnp.where(covered, thresh, f_thresh))
+
+    kth, thresh = jax.lax.cond(
+        jnp.all(covered), lambda _: (kth, thresh), deep, operand=None)
+    keep = (scaled >= kth[:, None]) & (scaled >= thresh[:, None])
+    return jnp.where(keep, scaled, -jnp.inf)
 
 
 def sample_tokens(logits: jax.Array, temps: jax.Array,

@@ -1289,6 +1289,225 @@ def bench_spec_decode():
     }}
 
 
+def bench_grad_lifecycle(iters):
+    """ISSUE-14 A/B: the historical distributed step (per-leaf psum
+    with the fp32 round-trip, handing a grads PYTREE to the packed
+    FusedAdam, which re-packs it — BENCH_GRAD_BASELINE=tree for the
+    non-packed pytree optimizer instead) vs the fused flat-bucket
+    gradient lifecycle (``GradBuckets`` psum-per-bucket raw sums ->
+    read-only ``found_inf_flat`` -> ``step_flat`` with the bucket
+    concat, unscale, deferred gradient average and in-kernel overflow
+    noop all fused into ONE update sweep; fp32 masters are the param
+    store, the forward reads unpack views of them).
+
+    The model is a deliberately cheap multi-leaf regression so the
+    GRADIENT LIFECYCLE dominates the step — the leg prices exactly the
+    path the tentpole rewired. Reported: steps/s both sides, the
+    speedup, and XLA ``cost_analysis`` flops/bytes ratios (< 1 = the
+    flat lifecycle touches less memory / does less work per step; the
+    bytes ratio is the acceptance number). Runs at whatever mesh size
+    the process has (1 CPU device under the driver; set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` for a real
+    multi-device CPU mesh — the committed smoke artifact uses 2).
+    ``BENCH_GRAD_PARAMS`` sizes the parameter set (elements),
+    ``BENCH_GRAD_BUCKET_MB`` the bucket cap.
+    """
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import telemetry
+    from apex_tpu.amp import LossScaler
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import (
+        DistributedDataParallel, GradBuckets, sync_gradients,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    total = int(os.environ.get(
+        "BENCH_GRAD_PARAMS", str(64 * 2**20 if on_tpu else 2**20)))
+    bucket_mb = float(os.environ.get("BENCH_GRAD_BUCKET_MB", "4"))
+    n_leaves = 24
+    world = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    batch = 4 * world
+
+    keys = jax.random.split(jax.random.PRNGKey(0), n_leaves)
+    per = max(total // n_leaves, 8)
+    # odd sizes exercise the padding/alignment machinery like a real
+    # transformer pytree would; bf16 params + fp32 masters is the
+    # headline GPT configuration — the one whose per-leaf fp32
+    # round-trips the ISSUE-14 motivation names
+    dtype = jnp.dtype(os.environ.get("BENCH_GRAD_DTYPE", "bfloat16"))
+    params = {
+        f"w{i:02d}": (0.1 * jax.random.normal(
+            keys[i], (per + (i % 3) * 17,), jnp.float32)
+        ).astype(dtype)
+        for i in range(n_leaves)
+    }
+    # kernel chunk sized to the workload: the reference's 64Ki-element
+    # default would pad this ~1M-element toy pytree by ~6% (one chunk
+    # round-up per bucket), and every lifecycle sweep pays the padding
+    chunk = int(os.environ.get("BENCH_GRAD_CHUNK", "8192"))
+    buckets = GradBuckets(params, bucket_cap_mb=bucket_mb,
+                          chunk_size=chunk, reduce_dtype=jnp.float32)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (batch,), jnp.float32)
+
+    def loss_fn(p, x):
+        # a batch-dependent quadratic in every leaf (grads everywhere,
+        # different per shard) whose forward/backward is ONE cheap
+        # elementwise sweep — the gradient lifecycle IS the step
+        s = 1.0 + 0.01 * jnp.mean(x)
+        acc = jnp.float32(0.0)
+        for leaf in jax.tree_util.tree_leaves(p):
+            acc += jnp.mean((leaf.astype(jnp.float32) * s) ** 2)
+        return acc / len(p)
+
+    def build(flat):
+        scaler = LossScaler(loss_scale="dynamic", init_scale=2.0 ** 8)
+        if flat:
+            opt = FusedAdam(lr=1e-3, master_weights=True, packed=True,
+                            packed_spec=buckets.spec)
+            # gradient_average=False: the /world rides the kernel's one
+            # inv_scale multiply instead of its own sweep (exact — loss
+            # scale and world size are both powers of two)
+            ddp = DistributedDataParallel(
+                "data", allreduce_always_fp32=True,
+                gradient_average=False, bucket_cap_mb=bucket_mb)
+            bytes_per_step = buckets.sweep_bytes()
+        else:
+            # the historical distributed step of THIS repo: per-leaf
+            # sync_gradients composed with the headline packed optimizer
+            # (BENCH_GPT_PACKED default since the packed PRs) — the
+            # reduction hands a PYTREE to an optimizer that immediately
+            # re-packs it. BENCH_GRAD_BASELINE=tree swaps in the
+            # non-packed pytree FusedAdam instead.
+            baseline_packed = os.environ.get(
+                "BENCH_GRAD_BASELINE", "packed") != "tree"
+            opt = FusedAdam(lr=1e-3, master_weights=True,
+                            packed=baseline_packed,
+                            packed_chunk_size=chunk)
+        rec = telemetry_recorder()
+        tag = "grad_lifecycle_flat" if flat else "grad_lifecycle_per_leaf"
+
+        def shard_step(carry, sstate, metrics, loss_prev, x):
+            del loss_prev  # chained-step convention (_timed_steps)
+            # flat leg: the carry IS the packed optimizer state — params
+            # live in its fp32 MASTER buffer (apex O2 taken literally),
+            # and the forward takes bf16 leaf views cast from it
+            # (bit-identical to views of the kernel's packed bf16 p_out,
+            # but f32 slices stay regional reads where XLA CPU's bf16
+            # emulation would re-read the whole half-precision buffer
+            # per leaf). per-leaf leg: carry = (params pytree, state).
+            if flat:
+                opt_state = carry
+                p_tree = buckets.unpack(opt_state.master_params)
+            else:
+                p_tree, opt_state = carry
+
+            def scaled(p):
+                loss = loss_fn(p, x)
+                return scaler.scale_loss(sstate, loss), loss
+
+            (_, loss), grads = jax.value_and_grad(
+                scaled, has_aux=True)(p_tree)
+            if flat:
+                # the tentpole lifecycle, fused spelling: cast up once
+                # per bucket, one RAW psum per bucket, found_inf
+                # read-only off the bucket buffers, then ONE update
+                # sweep — the bucket concat arrives lazily
+                # (BucketBuffers), the unscale multiply AND the deferred
+                # gradient average ride grad_scale into the kernel's
+                # inv_scale, and the overflow skip is the kernels'
+                # in-sweep noop flag (no lax.cond, so XLA keeps the
+                # donated state buffers aliased in place)
+                bufs, _ = ddp.reduce_flat(grads, buckets=buckets,
+                                          concat=False)
+                new_ss = scaler.found_inf_flat(sstate, bufs)
+                carry = opt.step_flat(
+                    bufs, opt_state,
+                    found_inf=new_ss.found_inf,
+                    grad_scale=new_ss.loss_scale * world)
+            else:
+                # the historical per-leaf step the motivation names:
+                # every leaf round-trips through fp32 at the reduction
+                # (legacy downcast), the unscale sweeps it again in the
+                # grad dtype, and the optimizer re-upcasts — three
+                # touches of every gradient before the update reads it
+                grads = sync_gradients(grads, "data",
+                                       allreduce_always_fp32=True)
+                g, new_ss = scaler.unscale(sstate, grads)
+                p_tree, opt_state = opt.step(g, opt_state, p_tree,
+                                             found_inf=new_ss.found_inf)
+                carry = (p_tree, opt_state)
+            new_ss = scaler.update_scale(new_ss)
+            loss = jax.lax.pmean(loss.astype(jnp.float32), "data")
+            metrics = telemetry.accumulate(metrics, loss=loss,
+                                           tokens=batch)
+            # the satellite wiring: per-drain achieved GB/s against the
+            # bucketed reduce's algorithmic sweep bytes (flat leg only —
+            # the per-leaf path has no packed denominator to report)
+            metrics = telemetry.drain(
+                metrics, rec, every_n=5, tag=tag,
+                bytes_per_step=(bytes_per_step if flat else None))
+            return carry, new_ss, metrics, loss
+
+        step = jax.jit(shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P("data")),
+            out_specs=(P(), P(), P(), P()), check_rep=False),
+            donate_argnums=(0, 1, 2))
+        # both legs start from identical values, each on FRESH buffers
+        # (the timed runs donate their params/state)
+        p0 = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), params)
+        carry0 = opt.init(p0) if flat else (p0, opt.init(p0))
+        args = (carry0, scaler.init_state(),
+                telemetry.init_metrics(), jnp.float32(0))
+        return step, args
+
+    out = {}
+    costs = {}
+    for name, flat in (("per_leaf", False), ("flat", True)):
+        step, args = build(flat)
+        compiled = step.lower(*args, xs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        costs[name] = (float(ca.get("flops", 0.0)),
+                       float(ca.get("bytes accessed", 0.0)))
+        dt, final_loss, _ = _timed_steps(
+            lambda *s: compiled(*s, xs), args, iters,
+            leg=f"grad_lifecycle_{name}")
+        if not math.isfinite(final_loss):
+            raise RuntimeError(
+                f"grad_lifecycle {name} loss not finite: {final_loss}")
+        out[name] = {"step_ms": round(dt / iters * 1e3, 3),
+                     "steps_per_sec": round(iters / dt, 2),
+                     "final_loss": round(final_loss, 6)}
+
+    (pl_fl, pl_by), (fl_fl, fl_by) = costs["per_leaf"], costs["flat"]
+    return {"grad_lifecycle": {
+        "per_leaf": out["per_leaf"],
+        "flat": out["flat"],
+        # > 1: the flat-bucket lifecycle is faster
+        "speedup": round(out["per_leaf"]["step_ms"]
+                         / out["flat"]["step_ms"], 4),
+        # < 1: the flat lifecycle does less work per step (the
+        # three-plus-HBM-sweeps -> one story, priced by XLA's own cost
+        # model so it holds on CPU where wall time is noisy)
+        "flops_ratio": (round(fl_fl / pl_fl, 4) if pl_fl else None),
+        "bytes_ratio": (round(fl_by / pl_by, 4) if pl_by else None),
+        "world": world,
+        "params": sum(int(l.size) for l in
+                      jax.tree_util.tree_leaves(params)),
+        "n_buckets": buckets.n_buckets,
+        "bucket_cap_mb": bucket_mb,
+        "sweep_bytes_per_step": buckets.sweep_bytes(),
+    }}
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -1785,6 +2004,25 @@ def main() -> None:
             print(f"spec decode bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # grad_lifecycle leg: the ISSUE-14 A/B (per-leaf psum + pytree
+    # optimizer vs the flat-bucket lifecycle) — steps/s + cost_analysis
+    # flops/bytes ratios. Cheap (tiny synthetic model), but still a
+    # compile, so fast mode skips it unless BENCH_GRAD_LIFECYCLE=1
+    # forces it (the CPU smoke configuration; artifact committed under
+    # bench_artifacts/). BENCH_GRAD_LIFECYCLE=0 skips everywhere.
+    grad_lifecycle = None
+    want_gl = os.environ.get("BENCH_GRAD_LIFECYCLE")
+    if want_gl != "0" and (not fast or want_gl == "1"):
+        try:
+            grad_lifecycle = _retry_transient(
+                lambda: bench_grad_lifecycle(max(iters, 10)),
+                tag="grad lifecycle leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"grad lifecycle bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -1857,6 +2095,7 @@ def main() -> None:
         "serving_fleet": (serving_fleet or {}).get("serving_fleet"),
         "prefix_reuse": (prefix_reuse or {}).get("prefix_reuse"),
         "spec_decode": (spec_decode or {}).get("spec_decode"),
+        "grad_lifecycle": (grad_lifecycle or {}).get("grad_lifecycle"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

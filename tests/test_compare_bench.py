@@ -227,3 +227,53 @@ def test_category_appearing_counts_as_shift():
     rep = compare(base, new)
     regressed = {r["leg"] for r in rep["regressions"]}
     assert "op_category:fusion(unattributed)" in regressed
+
+
+def _bench_with_grad_lifecycle(speedup=1.9, bytes_ratio=0.95,
+                               steps_per_sec=50.0):
+    b = _bench()
+    b["grad_lifecycle"] = {
+        "per_leaf": {"steps_per_sec": steps_per_sec / speedup},
+        "flat": {"steps_per_sec": steps_per_sec},
+        "speedup": speedup,
+        "bytes_ratio": bytes_ratio,
+        "flops_ratio": 1.1,
+    }
+    return b
+
+
+def test_grad_lifecycle_legs_extract_and_gate():
+    """ISSUE-14: the flat-vs-per-leaf A/B is a first-class gated leg —
+    speedup and flat steps/s regress like throughput, and bytes_ratio
+    regresses when it RISES back toward parity (lower is better)."""
+    legs = extract_legs(_bench_with_grad_lifecycle())
+    assert legs["grad_lifecycle_speedup"] == 1.9
+    assert legs["grad_lifecycle_bytes_ratio"] == -0.95  # lower-is-better
+    assert legs["grad_lifecycle_steps_per_sec"] == 50.0
+
+    base = _bench_with_grad_lifecycle()
+    worse = _bench_with_grad_lifecycle(speedup=1.2, bytes_ratio=1.05,
+                                       steps_per_sec=40.0)
+    rep = compare(base, worse, threshold=0.05)
+    regressed = {r["leg"] for r in rep["regressions"]}
+    assert {"grad_lifecycle_speedup", "grad_lifecycle_bytes_ratio",
+            "grad_lifecycle_steps_per_sec"} <= regressed
+    # improvement direction: bytes_ratio FALLING is an improvement
+    better = _bench_with_grad_lifecycle(bytes_ratio=0.80)
+    rep2 = compare(base, better, threshold=0.05)
+    improved = {r["leg"] for r in rep2["improvements"]}
+    assert "grad_lifecycle_bytes_ratio" in improved
+
+
+def test_grad_lifecycle_smoke_artifact_carries_gated_legs():
+    """The committed CPU smoke artifact records the acceptance numbers
+    the gates act on: bytes_ratio < 1.0 and speedup > 1 with equal
+    final_loss on both legs (the bit-identity witness)."""
+    art = json.loads(
+        (REPO / "bench_artifacts/grad_lifecycle_cpu_smoke.json")
+        .read_text())
+    leg = art["grad_lifecycle"]
+    assert leg["bytes_ratio"] < 1.0
+    assert leg["speedup"] > 1.0
+    assert leg["flat"]["final_loss"] == leg["per_leaf"]["final_loss"]
+    assert leg["n_buckets"] >= 2 and leg["world"] >= 2

@@ -22,6 +22,98 @@ import pytest
 SCRIPT = str(Path(__file__).parent / "_resilience_train.py")
 
 
+def test_bucketed_lifecycle_state_roundtrips_bit_exact(tmp_path):
+    """Quick-tier ISSUE-14 coverage: a TrainState carrying the bucketed
+    flat-buffer lifecycle's state (packed FusedAdam over
+    ``GradBuckets.spec`` — flat m/v/masters — plus the scaler) survives
+    ``capture`` -> ``CheckpointManager.save`` -> ``resume_or_init``
+    bit-exactly, and a resumed run continues the loss records of an
+    uninterrupted one byte-for-byte."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.amp import LossScaler
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import GradBuckets
+    from apex_tpu.resilience import (
+        CheckpointManager, capture, resume_or_init,
+    )
+
+    def init():
+        ks = jax.random.split(jax.random.PRNGKey(7), 2)
+        params = {"w": 0.1 * jax.random.normal(ks[0], (48, 32)),
+                  "b": 0.1 * jax.random.normal(ks[1], (32,))}
+        buckets = GradBuckets(params, bucket_cap_mb=0.005,
+                              chunk_size=2048)
+        opt = FusedAdam(lr=1e-2, master_weights=True, packed=True,
+                        packed_spec=buckets.spec)
+        scaler = LossScaler(loss_scale="dynamic", init_scale=4.0,
+                            scale_window=2)
+        return params, buckets, opt, scaler
+
+    def loss_fn(params, x):
+        return jnp.mean((jnp.tanh(x @ params["w"]) + params["b"]) ** 2)
+
+    def run(steps, start_state=None):
+        params, buckets, opt, scaler = init()
+        opt_state, sstate, s0 = opt.init(params), scaler.init_state(), 0
+        if start_state is not None:
+            s0 = start_state.step
+            params, opt_state = start_state.params, start_state.opt_state
+            sstate = start_state.scaler
+
+        @jax.jit
+        def step(params, opt_state, sstate, x):
+            def scaled(p):
+                loss = loss_fn(p, x)
+                return scaler.scale_loss(sstate, loss), loss
+
+            (_, loss), grads = jax.value_and_grad(
+                scaled, has_aux=True)(params)
+            flat = buckets.concat(buckets.pack(grads))
+            flat, new_ss = scaler.unscale_flat(sstate, flat,
+                                               out_dtype=jnp.float32)
+            params, opt_state = opt.step(flat, opt_state, params,
+                                         found_inf=new_ss.found_inf)
+            return params, opt_state, scaler.update_scale(new_ss), loss
+
+        records = {}
+        for s in range(s0, steps):
+            x = jax.random.normal(jax.random.PRNGKey(1000 + s), (16, 48))
+            params, opt_state, sstate, loss = step(params, opt_state,
+                                                   sstate, x)
+            records[s] = np.float32(loss).tobytes().hex()
+        return records, capture(steps, params, opt_state, scaler=sstate)
+
+    ref_records, _ = run(6)
+
+    # save at step 3, resume from the manager, continue to 6
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    head_records, head_state = run(3)
+    mgr.save(head_state, blocking=True)
+
+    def fresh():
+        params, _, opt, scaler = init()
+        return capture(0, params, opt.init(params),
+                       scaler=scaler.init_state())
+
+    restored, resumed = resume_or_init(
+        CheckpointManager(str(tmp_path / "ckpt"), async_save=False), fresh)
+    assert resumed and restored.step == 3
+    # bucket state round-trips bit-exact (flat buffers AND the static
+    # bucketed spec riding the template)
+    for a, b in zip(jax.tree_util.tree_leaves(restored.opt_state),
+                    jax.tree_util.tree_leaves(head_state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert restored.opt_state.spec == head_state.opt_state.spec
+    assert restored.opt_state.spec.bucket_bounds == \
+        head_state.opt_state.spec.bucket_bounds
+
+    tail_records, _ = run(6, start_state=restored)
+    assert {**head_records, **tail_records} == ref_records
+
+
 def _run(*args, timeout=180):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.run(

@@ -156,6 +156,52 @@ def test_sample_tokens_respects_topk_and_topp():
     assert int(tok[0]) == int(np.argmax(row[0]))
 
 
+def test_topk_prefilter_matches_full_sort_per_row():
+    """ISSUE-14 satellite: the lax.top_k prefilter and the full-sort
+    fallback must be bitwise interchangeable per row — a batch whose
+    OTHER rows force the deep path returns identical tokens for a row
+    the prefix already covered (the engine-vs-reference byte-identity
+    contract cannot depend on batch composition)."""
+    from apex_tpu.serving.sampling import TOP_FILTER_WIDTH, _thresholds
+
+    rng = np.random.default_rng(11)
+    V = 4 * TOP_FILTER_WIDTH
+    logits = jnp.asarray(rng.normal(size=(3, V)).astype(np.float32))
+    temps = jnp.full(3, 1.0)
+    # row 0: ordinary nucleus config (prefix covers it); row 1: top_k
+    # beyond the prefix width (forces the fallback); row 2: near-flat
+    # logits at high temperature with p close to 1 (top-width mass
+    # cannot reach p — the other fallback trigger)
+    flat_row = jnp.asarray(
+        0.01 * rng.normal(size=(V,)).astype(np.float32))
+    logits = logits.at[2].set(flat_row)
+    top_ks = jnp.asarray([8, TOP_FILTER_WIDTH + 7, 0], jnp.int32)
+    top_ps = jnp.asarray([0.9, 1.0, 0.999], jnp.float32)
+    seeds = jnp.zeros(3, jnp.int32)
+    rids = jnp.asarray([4, 5, 6], jnp.int32)
+    pos = jnp.asarray([10, 11, 12], jnp.int32)
+
+    # rows 1 and 2 genuinely trigger the deep path; row 0 does not
+    scaled = logits / temps[:, None]
+    _, _, covered = _thresholds(
+        jax.lax.top_k(scaled, TOP_FILTER_WIDTH)[0], scaled, top_ks,
+        top_ps)
+    assert np.asarray(covered).tolist() == [True, False, False]
+
+    batched = np.asarray(sample_tokens(
+        logits, temps, top_ks, top_ps, seeds, rids, pos))
+    for i in range(3):
+        single = np.asarray(sample_tokens(
+            logits[i:i + 1], temps[i:i + 1], top_ks[i:i + 1],
+            top_ps[i:i + 1], seeds[i:i + 1], rids[i:i + 1],
+            pos[i:i + 1]))
+        assert batched[i] == single[0], f"row {i} depends on the batch"
+    # the top_k>width row still respects its filter
+    topk_set = set(np.argsort(-np.asarray(logits[1]))
+                   [:TOP_FILTER_WIDTH + 7].tolist())
+    assert int(batched[1]) in topk_set
+
+
 def test_sample_tokens_key_separation():
     """Different (seed | rid | position) keys decorrelate draws — the
     carried-PRNG contract that makes two same-seed requests sample

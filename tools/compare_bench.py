@@ -61,6 +61,15 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("fleet_ttft_p99_ms", "serving_fleet.ttft_p99_ms", False),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
     ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
+    # ISSUE-14 flat-buffer gradient lifecycle A/B: the flat leg must stay
+    # faster than the per-leaf historical step, and the XLA-cost-model
+    # ratios must stay below parity (bytes_ratio < 1.0 is the acceptance
+    # number; a rise back toward 1 is a regression even if wall time
+    # noise hides it)
+    ("grad_lifecycle_speedup", "grad_lifecycle.speedup", True),
+    ("grad_lifecycle_bytes_ratio", "grad_lifecycle.bytes_ratio", False),
+    ("grad_lifecycle_steps_per_sec",
+     "grad_lifecycle.flat.steps_per_sec", True),
 )
 
 # legs whose expected value is ~0, where a relative threshold would turn
