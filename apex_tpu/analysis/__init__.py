@@ -27,7 +27,12 @@ from .auditor import (  # noqa: F401
     trace_step,
 )
 from .report import AuditReport, Finding, SEVERITIES  # noqa: F401
-from .rules import RULES, AuditConfig, check_pack_spec  # noqa: F401
+from .rules import (  # noqa: F401
+    RULES,
+    AuditConfig,
+    check_pack_spec,
+    check_reshard,
+)
 from .walk import WalkCtx, collect_consts, walk  # noqa: F401
 
 __all__ = [
@@ -41,6 +46,7 @@ __all__ = [
     "assert_step_clean",
     "audit_step",
     "check_pack_spec",
+    "check_reshard",
     "collect_consts",
     "trace_step",
     "walk",

@@ -569,6 +569,59 @@ def check_pack_spec(spec: PackSpec, *, shard_count: Optional[int] = None,
     return out
 
 
+def check_reshard(old_spec: PackSpec, new_spec: PackSpec, *,
+                  old_count: Optional[int] = None,
+                  new_count: Optional[int] = None,
+                  where: str = "") -> List[Finding]:
+    """Static verification that a packed buffer laid out under
+    ``old_spec`` can be re-flattened bit-exactly into ``new_spec`` — the
+    machine check of the elastic topology-resume path
+    (``resilience.elastic.reflatten_flat``): a checkpoint saved at world
+    size W (``old_count`` shards of ``old_spec``) restoring onto W′
+    hosts (``new_count`` shards of ``new_spec``).
+
+    Both specs must individually pass :func:`check_pack_spec` at their
+    shard counts, AND describe the same logical leaves (shapes + dtypes
+    in flatten order — offsets/padding/bucketing may differ freely;
+    those are exactly what re-flattening rewrites). A mismatch in the
+    leaf sequence means the two layouts belong to different models and
+    any element copy between them is silent corruption, so it is
+    error-severity.
+    """
+    w = where or f"{old_spec!r} -> {new_spec!r}"
+    out: List[Finding] = []
+    out.extend(check_pack_spec(old_spec, shard_count=old_count,
+                               where=f"{w} [old]"))
+    out.extend(check_pack_spec(new_spec, shard_count=new_count,
+                               where=f"{w} [new]"))
+    old_dtypes = tuple(str(d) for d in old_spec.dtypes)
+    new_dtypes = tuple(str(d) for d in new_spec.dtypes)
+    if old_spec.shapes != new_spec.shapes or old_dtypes != new_dtypes:
+        if old_spec.n_leaves != new_spec.n_leaves:
+            detail = (f"{old_spec.n_leaves} vs {new_spec.n_leaves} "
+                      "leaves")
+            bad = []
+        else:
+            bad = [i for i, (os_, ns, od, nd) in enumerate(
+                zip(old_spec.shapes, new_spec.shapes,
+                    old_dtypes, new_dtypes))
+                if os_ != ns or od != nd]
+            i0 = bad[0]
+            detail = (f"{len(bad)} of {old_spec.n_leaves} leaves "
+                      f"differ; first: leaf {i0} "
+                      f"{old_spec.shapes[i0]}/{old_dtypes[i0]} vs "
+                      f"{new_spec.shapes[i0]}/{new_dtypes[i0]}")
+        out.append(Finding(
+            "packing", "reshard_leaf_mismatch", "error",
+            f"old and new PackSpecs describe different leaf sequences "
+            f"({detail}) — re-flattening between them copies elements "
+            "across unrelated tensors", where=w,
+            data={"old_n_leaves": old_spec.n_leaves,
+                  "new_n_leaves": new_spec.n_leaves,
+                  "mismatched_leaves": bad[:8]}))
+    return out
+
+
 def rule_packing(trace, cfg: AuditConfig) -> List[Finding]:
     out: List[Finding] = []
     for i, spec in enumerate(trace.pack_specs):

@@ -71,6 +71,58 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def fsync_file(path: str) -> None:
+    """Flush one file's data+metadata to stable storage.
+
+    ``os.rename`` orders nothing by itself: a machine crash (power loss,
+    not just a process kill) straddling a tmp+rename commit can leave
+    the rename durable while the renamed tree's *contents* are still in
+    the page cache — a committed-looking checkpoint full of zero-length
+    files. Callers fsync the payload files, then the directory entries
+    (:func:`fsync_dir`), then rename, then fsync the parent."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Flush a directory's entries (creations/renames inside it) to
+    stable storage — the other half of a durable rename commit. On
+    platforms where directories cannot be opened/fsynced (Windows), the
+    flush is skipped: the atomicity story there is process-crash-only,
+    which matches the rest of this module."""
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(path, flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; best effort
+    finally:
+        os.close(fd)
+
+
+def fsync_tree(path: str) -> None:
+    """Flush a whole staged checkpoint tree — every regular file
+    (:func:`fsync_file`) and every directory (:func:`fsync_dir`),
+    bottom-up — before the rename that commits it. This is the payload
+    half of durability: the tensorstore array files orbax wrote give no
+    page-cache guarantee of their own, and a machine crash after a
+    durable rename but before their writeback would leave a
+    committed-looking step of empty files."""
+    for dirpath, _dirnames, filenames in os.walk(path, topdown=False):
+        for fn in filenames:
+            try:
+                fsync_file(os.path.join(dirpath, fn))
+            except OSError:
+                pass  # vanished/unreadable entries are best effort
+        fsync_dir(dirpath)
+
+
 def stale_writer(pid: int) -> bool:
     """True when a ``*.tmp-<pid>`` staging tree cannot still be being
     written: the pid is our own (a prior call in this process left it

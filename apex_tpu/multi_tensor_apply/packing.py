@@ -260,6 +260,30 @@ class PackSpec:
     def zeros(self, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros((self.total,), dtype)
 
+    def shard_bounds(self, shard_count: int) -> Tuple[Tuple[int, int], ...]:
+        """Equal ROW-aligned per-shard element ranges ``[(lo, hi), ...]``
+        partitioning ``[0, total)`` into ``shard_count`` contiguous
+        shards — the row-sliced checkpoint shards of the elastic
+        multi-host service (``resilience.elastic``) and the ZeRO-sharded
+        packed layout. Raises when the layout does not admit equal
+        ROW-aligned shards (``analysis.check_pack_spec(spec,
+        shard_count=n)`` is the machine check; this is the runtime
+        guard on the same invariant)."""
+        shard_count = int(shard_count)
+        if shard_count <= 0:
+            raise ValueError(f"shard_count must be > 0, got {shard_count}")
+        if self.total % shard_count:
+            raise ValueError(
+                f"total {self.total} is not divisible by shard_count "
+                f"{shard_count} — build the spec with a chunk_size that "
+                f"is a multiple of shard_count*ROW ({shard_count * ROW})")
+        size = self.total // shard_count
+        if size % ROW:
+            raise ValueError(
+                f"shard size {size} is not ROW-aligned ({ROW}) — shard "
+                "boundaries would split rows")
+        return tuple((h * size, (h + 1) * size) for h in range(shard_count))
+
     def leaf_names(self) -> Tuple[str, ...]:
         """Human-readable leaf path strings in flatten order (via
         ``jax.tree_util.keystr``) — the names overflow-provenance events

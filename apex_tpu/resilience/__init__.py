@@ -25,12 +25,23 @@ response half — the run must *survive* what they detect:
   and the bench legs;
 - :mod:`~apex_tpu.resilience.chaos` — fault injection (NaN gradients,
   failed/truncated checkpoint writes, fake preemption, stalled
-  callbacks) driving the tests and ``tools/resilience_check.py --self``.
+  callbacks, SIGKILLed fake hosts) driving the tests and
+  ``tools/resilience_check.py --self``;
+- :mod:`~apex_tpu.resilience.elastic` — the ELASTIC SERVICE: a
+  :class:`Supervisor` running the train loop as N fake-host
+  subprocesses with death/hang detection and world restart, the
+  two-phase multi-host checkpoint commit
+  (:class:`ElasticCheckpointManager` — per-host ``shard-<h>.part``
+  staging, filesystem rendezvous, rank-0 ``COMMIT`` promotion,
+  markerless steps are garbage), and topology-elastic resume
+  (:func:`reflatten_flat` re-slices the packed opt state bit-exactly
+  onto a different world size). CLI: ``tools/elastic_supervisor.py``.
 
 See ``docs/resilience.md`` for the end-to-end story.
 """
 from .chaos import (  # noqa: F401
     ChaosError,
+    ChaosHost,
     ChaosMonkey,
     ServingChaos,
     StallingSink,
@@ -39,13 +50,27 @@ from .chaos import (  # noqa: F401
     request_storm,
     send_preemption,
 )
+from .elastic import (  # noqa: F401
+    COMMIT_MARKER,
+    ElasticCheckpointManager,
+    Heartbeat,
+    Supervisor,
+    WorldFailedError,
+    grad_buckets_for_world,
+    pack_spec_for_world,
+    reflatten_flat,
+    sharded_leaf_indices,
+    world_chunk_size,
+)
 from .manager import (  # noqa: F401
     CHECKPOINT_IO_POLICY,
     CheckpointManager,
     PreemptionError,
 )
 from .retry import (  # noqa: F401
+    ELASTIC_BARRIER_POLICY,
     TRANSIENT_COMPILE_POLICY,
+    BarrierNotReady,
     RetryPolicy,
     retry_call,
 )
@@ -69,12 +94,17 @@ from .watchdog import (  # noqa: F401
 
 __all__ = [
     "CHECKPOINT_IO_POLICY", "CheckpointManager", "PreemptionError",
-    "TRANSIENT_COMPILE_POLICY", "RetryPolicy", "retry_call",
+    "ELASTIC_BARRIER_POLICY", "TRANSIENT_COMPILE_POLICY",
+    "BarrierNotReady", "RetryPolicy", "retry_call",
     "RewindController", "RewindExhaustedError",
     "IndexedBatches", "ResumableIterator", "TrainState", "capture",
     "host_snapshot", "resume_or_init",
     "HangError", "HangWatchdog", "dump_all_stacks",
-    "ChaosError", "ChaosMonkey", "ServingChaos", "StallingSink",
-    "corrupt_checkpoint", "poison_grads", "request_storm",
-    "send_preemption",
+    "ChaosError", "ChaosHost", "ChaosMonkey", "ServingChaos",
+    "StallingSink", "corrupt_checkpoint", "poison_grads",
+    "request_storm", "send_preemption",
+    "COMMIT_MARKER", "ElasticCheckpointManager", "Heartbeat",
+    "Supervisor", "WorldFailedError", "grad_buckets_for_world",
+    "pack_spec_for_world", "reflatten_flat", "sharded_leaf_indices",
+    "world_chunk_size",
 ]

@@ -332,6 +332,150 @@ class ServingChaos:
         return n
 
 
+class ChaosHost:
+    """Host-process faults for the elastic service's supervised fake
+    hosts — where :class:`ChaosMonkey` raises exceptions a single
+    process survives, this one **kills the process** (SIGKILL: no
+    cleanup, no flush, exactly a preempted host) at the seams the
+    two-phase commit must cover:
+
+    - :meth:`kill_at_step` — SIGKILL at a step boundary (mid-step from
+      the world's point of view: peers are between collectives).
+    - :meth:`kill_in_shard_write_at` — SIGKILL mid-``.part`` write:
+      the shard's arrays are on disk, its meta/rename are not — a torn
+      shard that must read as garbage, never as data.
+    - :meth:`kill_in_barrier_at` — SIGKILL while waiting in the commit
+      barrier: this host's shard landed, the COMMIT marker never will —
+      the markerless-step-is-garbage case.
+    - :meth:`wedge_heartbeat_at` — stop heartbeating for ``stall_s``
+      (the silent-hang fault); the supervisor's staleness detector must
+      declare the host hung and restart the world.
+
+    Faults fire once (crossing the armed step also fires, so a world
+    that restarts *past* the armed step does not dodge its fault, and a
+    restarted host re-running the same steps does not re-die). The
+    hooks double as the manager's chaos seams: ``before_write`` (step
+    boundary alias), ``mid_part_write``, ``before_commit`` /
+    ``in_barrier`` (barrier window). Armed sets serialize through
+    :meth:`to_spec` / :meth:`parse` (``"kill@7,kill_write@6,`` ``kill_
+    barrier@5,wedge@9:30"``) so a supervisor can arm a child host
+    through its environment/argv.
+    """
+
+    def __init__(self):
+        self._kill_step: Optional[int] = None
+        self._kill_write: Optional[int] = None
+        self._kill_barrier: Optional[int] = None
+        self._wedge: Optional[tuple] = None  # (step, stall_s)
+        self.faults_fired: list = []
+
+    # -- arming ------------------------------------------------------------
+    def kill_at_step(self, step: int) -> "ChaosHost":
+        self._kill_step = int(step)
+        return self
+
+    def kill_in_shard_write_at(self, step: int) -> "ChaosHost":
+        self._kill_write = int(step)
+        return self
+
+    def kill_in_barrier_at(self, step: int) -> "ChaosHost":
+        self._kill_barrier = int(step)
+        return self
+
+    def wedge_heartbeat_at(self, step: int,
+                           stall_s: float = 3600.0) -> "ChaosHost":
+        self._wedge = (int(step), float(stall_s))
+        return self
+
+    # -- spec round-trip (supervisor -> child host) ------------------------
+    def to_spec(self) -> str:
+        parts = []
+        if self._kill_step is not None:
+            parts.append(f"kill@{self._kill_step}")
+        if self._kill_write is not None:
+            parts.append(f"kill_write@{self._kill_write}")
+        if self._kill_barrier is not None:
+            parts.append(f"kill_barrier@{self._kill_barrier}")
+        if self._wedge is not None:
+            parts.append(f"wedge@{self._wedge[0]}:{self._wedge[1]}")
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosHost":
+        out = cls()
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, arg = part.partition("@")
+            if kind == "kill":
+                out.kill_at_step(int(arg))
+            elif kind == "kill_write":
+                out.kill_in_shard_write_at(int(arg))
+            elif kind == "kill_barrier":
+                out.kill_in_barrier_at(int(arg))
+            elif kind == "wedge":
+                step, _, stall = arg.partition(":")
+                out.wedge_heartbeat_at(int(step),
+                                       float(stall) if stall else 3600.0)
+            else:
+                raise ValueError(f"unknown chaos fault {part!r} "
+                                 f"(spec {spec!r})")
+        return out
+
+    # -- the kill itself ---------------------------------------------------
+    @staticmethod
+    def _die() -> None:
+        # SIGKILL self: no handlers, no atexit, threads gone mid-write
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # unreachable; belt for exotic platforms
+
+    def _take(self, attr: str, step: int) -> bool:
+        armed = getattr(self, attr)
+        if armed is not None and int(step) >= armed:
+            setattr(self, attr, None)
+            return True
+        return False
+
+    # -- hooks (host loop + ElasticCheckpointManager seams) ----------------
+    def at_step_boundary(self, step: int) -> None:
+        if self._take("_kill_step", step):
+            self.faults_fired.append(("kill", int(step)))
+            self._die()
+
+    def before_write(self, step: int) -> None:
+        """Manager seam; step-boundary kills also honored here so a
+        save-driven loop without an explicit boundary call still dies."""
+        if self._take("_kill_step", step):
+            self.faults_fired.append(("kill", int(step)))
+            self._die()
+
+    def mid_part_write(self, step: int) -> None:
+        if self._take("_kill_write", step):
+            self.faults_fired.append(("kill_write", int(step)))
+            self._die()
+
+    def before_commit(self, step: int) -> None:
+        if self._take("_kill_barrier", step):
+            self.faults_fired.append(("kill_barrier", int(step)))
+            self._die()
+
+    def in_barrier(self, step: int) -> None:
+        if self._take("_kill_barrier", step):
+            self.faults_fired.append(("kill_barrier", int(step)))
+            self._die()
+
+    def take_wedge(self, step: int) -> Optional[float]:
+        """Stall seconds to sleep WITHOUT heartbeating at this step (the
+        host loop consults it each boundary), or None."""
+        if self._wedge is not None and int(step) >= self._wedge[0]:
+            _, stall = self._wedge
+            self._wedge = None
+            self.faults_fired.append(("wedge", int(step)))
+            return stall
+        return None
+
+
 def request_storm(engine, seed: int = 0) -> List[tuple]:
     """A batch of malformed/oversized serving requests built against a
     live engine's actual limits, each paired with the

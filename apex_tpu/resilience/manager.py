@@ -56,6 +56,8 @@ from typing import Callable, List, Optional
 
 from ..checkpoint import (
     CheckpointCorruptError,
+    fsync_dir,
+    fsync_tree,
     load_checkpoint,
     save_checkpoint,
     stale_writer,
@@ -238,10 +240,7 @@ class CheckpointManager:
             blocking = (not self.async_save) if blocking is None else blocking
             self.wait_until_finished()  # barrier + surface prev failure
         step = int(state.step)
-        snapshot = {k: _snapshot_leaf(v)
-                    for k, v in flat_leaves(device_part(state)).items()}
-        meta = {"step": step, "data": state.data, "emergency": emergency,
-                "format": "apex_tpu.train_state.v1"}
+        snapshot, meta = self._snapshot_and_meta(state, emergency)
         if blocking:
             self._write(step, snapshot, meta,
                         lock_timeout_s=(30.0 if emergency else None))
@@ -252,6 +251,19 @@ class CheckpointManager:
             target=self._write_async, args=(step, snapshot, meta),
             name=f"apex-tpu-ckpt-save-{step}", daemon=True)
         self._thread.start()
+
+    def _snapshot_and_meta(self, state: TrainState, emergency: bool):
+        """Donation-safe snapshot + host-side meta for one save — THE
+        subclass hook: :class:`~apex_tpu.resilience.elastic.
+        ElasticCheckpointManager` overrides it to snapshot only this
+        host's shard, while the save/async/emergency scaffolding stays
+        inherited."""
+        snapshot = {k: _snapshot_leaf(v)
+                    for k, v in flat_leaves(device_part(state)).items()}
+        meta = {"step": int(state.step), "data": state.data,
+                "emergency": bool(emergency),
+                "format": "apex_tpu.train_state.v1"}
+        return snapshot, meta
 
     def _write_async(self, step, snapshot, meta) -> None:
         try:
@@ -298,6 +310,15 @@ class CheckpointManager:
                     sink=self._record)
                 with open(os.path.join(tmp, "meta.json"), "w") as f:
                     json.dump(meta, f)
+                # durability, not just atomicity: rename orders nothing
+                # on its own — a MACHINE crash straddling the commit
+                # could persist the rename while the array payload,
+                # meta.json or the tmp dir's entries were still
+                # page-cache-only, leaving a committed-looking step
+                # with empty files. Flush the whole staged tree (arrays
+                # included), rename, then flush the parent so the
+                # commit itself is on stable storage.
+                fsync_tree(tmp)
                 if self.chaos is not None:
                     self.chaos.before_commit(step)
                 try:
@@ -317,6 +338,7 @@ class CheckpointManager:
                         # removed it)
                         shutil.rmtree(final, ignore_errors=True)
                     os.rename(tmp, final)
+                    fsync_dir(self.root)
                 except OSError:
                     if os.path.isdir(final):
                         # lost a same-step commit race (rename cannot

@@ -72,6 +72,13 @@ class RetryPolicy:
       a request-level SLO must bound the *total* time burned retrying,
       not just how many times it spun (serving request retry,
       ``ServingEngine.generate(retry_failed=...)``).
+    - ``emit_every``: stderr/telemetry cadence — only every N-th failed
+      transient attempt is printed and recorded (default 1: every
+      attempt, the historical behaviour). High-frequency poll loops
+      driven through retry (the elastic commit barrier re-polls a
+      shared directory hundreds of times) set this so a *normal* wait
+      does not flood the event stream; the first attempt and the
+      deadline event always emit.
     """
 
     attempts: int = 3
@@ -80,6 +87,7 @@ class RetryPolicy:
     base_delay: float = 0.0
     max_delay: float = 30.0
     deadline: Optional[float] = None
+    emit_every: int = 1
     rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def is_transient(self, e: BaseException) -> bool:
@@ -102,6 +110,32 @@ TRANSIENT_COMPILE_POLICY = RetryPolicy(
     attempts=3,
     retry_on=(Exception,),
     message_filter=_transient_compile_transport,
+)
+
+
+class BarrierNotReady(RuntimeError):
+    """A filesystem rendezvous poll found peers still missing.
+
+    The elastic commit barrier (``resilience.elastic``) raises this per
+    attempt so :func:`retry_call` owns the pacing: each re-poll is a
+    jittered-backoff "attempt", every one mirrored into telemetry as a
+    ``retry`` event — slow peers show up in the run's JSONL the same way
+    flaky storage does. The final attempt's :class:`BarrierNotReady`
+    surfaces as the barrier timeout."""
+
+
+#: The elastic multi-host commit barrier: many short re-polls of the
+#: shared checkpoint directory with bounded jittered backoff. Peers
+#: normally land within a step time; the generous attempt budget is for
+#: a peer mid-compile on its first save. Pair with ``deadline=`` (the
+#: manager derives it from ``barrier_timeout_s``) so the wall-clock
+#: bound — not the attempt count — is the contract.
+ELASTIC_BARRIER_POLICY = RetryPolicy(
+    attempts=10_000,
+    retry_on=(BarrierNotReady,),
+    base_delay=0.02,
+    max_delay=0.5,
+    emit_every=25,
 )
 
 
@@ -153,17 +187,21 @@ def retry_call(
                                 "deadline_s": policy.deadline,
                                 "elapsed_s": round(elapsed, 3)})
                     raise
-            print(
-                f"{tag}: transient {type(e).__name__}, retrying "
-                f"(attempt {attempt + 1}/{policy.attempts}"
-                + (f", backoff {d:.2f}s" if d else "") + ")",
-                file=sys.stderr,
-            )
-            if record is not None:
-                record({"event": "retry", "tag": tag,
-                        "attempt": attempt, "of": policy.attempts,
-                        "error": f"{type(e).__name__}: {e}",
-                        "delay_s": round(d, 3)})
+            emit = (attempt == 1
+                    or policy.emit_every <= 1
+                    or attempt % policy.emit_every == 0)
+            if emit:
+                print(
+                    f"{tag}: transient {type(e).__name__}, retrying "
+                    f"(attempt {attempt + 1}/{policy.attempts}"
+                    + (f", backoff {d:.2f}s" if d else "") + ")",
+                    file=sys.stderr,
+                )
+                if record is not None:
+                    record({"event": "retry", "tag": tag,
+                            "attempt": attempt, "of": policy.attempts,
+                            "error": f"{type(e).__name__}: {e}",
+                            "delay_s": round(d, 3)})
             if d:
                 sleep(d)
     raise last  # unreachable; keeps type-checkers honest

@@ -26,6 +26,13 @@ it:
 restore the newest good checkpoint if one exists, else initialize fresh.
 A resumed run replays the loss curve of an uninterrupted one bit-exactly
 on CPU/interpret backends (``tests/test_crash_resume.py`` pins this).
+
+The same template contract drives the elastic service
+(:mod:`~apex_tpu.resilience.elastic`): ``init_fn`` builds the state for
+THIS world's layout, and an :class:`ElasticCheckpointManager` restore
+re-flattens packed flat-buffer leaves saved at a different world size
+into the template's spec bit-exactly — the template always describes
+the run being started, never the run that saved.
 """
 from __future__ import annotations
 

@@ -92,14 +92,23 @@ class HangWatchdog:
     record (a recorder with ``.record`` or a bare callable). ``on_hang``
     replaces the default main-thread interrupt for :meth:`armed` blocks
     — it runs on the monitor thread with ``(what, stacks)``.
+
+    ``context`` is a small dict merged into EVERY hang event this
+    watchdog emits (per-call ``wait(context=)``/``armed(context=)``
+    keys win on conflict) — the training-side mirror of serving's
+    ``telemetry.TaggedRecorder``: a supervised fake host constructs its
+    watchdog with ``context={"host": h, "rank": h}`` so a multi-host
+    hang dump is attributable to the host that wedged without every
+    blocking point having to thread the ids through.
     """
 
     def __init__(self, timeout_s: float = 300.0, *, sink=None,
                  on_hang: Optional[Callable[[str, str], None]] = None,
-                 poll_s: float = 0.05):
+                 poll_s: float = 0.05, context: Optional[dict] = None):
         self.timeout_s = float(timeout_s)
         self.poll_s = float(poll_s)
         self.on_hang = on_hang
+        self.context = dict(context) if context else None
         from .retry import as_record
 
         self._record = as_record(sink)
@@ -240,7 +249,9 @@ class HangWatchdog:
             try:
                 rec = {"event": "hang", "what": what,
                        "timeout_s": timeout_s, "stacks": stacks}
-                if context:
+                if self.context:
+                    rec.update(self.context)
+                if context:  # per-call context wins on conflict
                     rec.update(context)
                 self._record(rec)
             except Exception:

@@ -1508,6 +1508,159 @@ def bench_grad_lifecycle(iters):
     }}
 
 
+def bench_elastic_mttr():
+    """``elastic_mttr`` leg (ISSUE-15): the elastic training service's
+    two headline costs, measured by actually killing a host.
+
+    - **MTTR** — a supervised world of ``BENCH_ELASTIC_WORLD`` fake-host
+      subprocesses suffers a SIGKILL mid-run; ``mttr_s`` is the
+      supervisor's incident-detect -> first-heartbeat-after-restart
+      time (process relaunch + jax init + restore from the newest
+      COMMITTED two-phase checkpoint). Dominated by interpreter/jax
+      startup on CPU; on a real pod it prices restore + rendezvous.
+    - **Save/commit overhead** — an in-process A/B of the same train
+      step with the ElasticCheckpointManager saving every
+      ``BENCH_ELASTIC_SAVE_EVERY`` steps (async shard write + commit
+      barrier) vs no checkpointing at all; ``save_overhead_pct`` is the
+      per-step cost of the armed two-phase machinery. Both legs run at
+      a ``BENCH_ELASTIC_STEP_MS`` (default 50) simulated step time —
+      the toy model's raw ms-scale step would only measure storage
+      latency vs cadence, not the machinery: the async design's
+      contract is ``save_every x step_time > write time`` (see
+      docs/resilience.md cost notes), and the A/B prices the
+      non-overlapped residual in that regime.
+
+    The leg FAILS (raises) if the post-kill loss records are not
+    byte-identical to the uninterrupted reference — a bench number for
+    a run that corrupted state would be worse than no number.
+    """
+    import shutil as _sh
+    import sys as _sys
+    import tempfile as _tmp
+    import time
+
+    from apex_tpu.resilience import (
+        ElasticCheckpointManager, IndexedBatches, Supervisor, capture,
+    )
+    from apex_tpu.resilience._elastic_host import (
+        batch_fn, build_world, init_params, make_train_step,
+        reference_records,
+    )
+
+    world = int(os.environ.get("BENCH_ELASTIC_WORLD", "2"))
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", "12"))
+    save_every = int(os.environ.get("BENCH_ELASTIC_SAVE_EVERY", "3"))
+    kill_at = int(os.environ.get("BENCH_ELASTIC_KILL_AT",
+                                 str(max(3, 2 * steps // 3))))
+    step_sleep_s = float(os.environ.get("BENCH_ELASTIC_STEP_MS",
+                                        "50")) / 1e3
+
+    # --- save/commit overhead: in-process A/B at world=1 layout -------
+    def loop(n, mgr):
+        params = init_params()
+        _, buckets, opt, sc = build_world(1)
+        step_fn = make_train_step(buckets, opt, sc)
+        opt_state, sstate = opt.init(params), sc.init_state()
+        rng = jax.random.PRNGKey(42)
+        it = IndexedBatches(batch_fn)
+        x, y = next(it)  # warm the compile outside the timed region
+        params, opt_state, sstate, rng, _ = step_fn(
+            params, opt_state, sstate, rng, x, y)
+        t0 = time.perf_counter()
+        for s in range(1, n + 1):
+            x, y = next(it)
+            params, opt_state, sstate, rng, loss = step_fn(
+                params, opt_state, sstate, rng, x, y)
+            if step_sleep_s:
+                time.sleep(step_sleep_s)  # identical in BOTH legs
+            if mgr is not None:
+                mgr.maybe_save(capture(
+                    s, params, opt_state, scaler=sstate, rng=rng,
+                    data=it.state()))
+        float(loss)
+        dt = time.perf_counter() - t0
+        if mgr is not None:
+            mgr.close()
+        return dt / n
+
+    ab_steps = max(20, steps)
+    bare_s = loop(ab_steps, None)
+    root_ab = _tmp.mkdtemp(prefix="apex_tpu_elastic_bench_ab_")
+    try:
+        mgr = ElasticCheckpointManager(
+            root_ab, host=0, world=1, keep_n=2, async_save=True,
+            save_every=save_every, barrier_timeout_s=60.0)
+        saved_s = loop(ab_steps, mgr)
+    finally:
+        _sh.rmtree(root_ab, ignore_errors=True)
+    overhead_pct = (saved_s / bare_s - 1.0) * 100.0
+
+    # --- MTTR: supervised subprocess world + one SIGKILL --------------
+    repo = os.path.dirname(os.path.abspath(__file__))
+    host_program = os.path.join(repo, "apex_tpu", "resilience",
+                                "_elastic_host.py")
+    run_dir = _tmp.mkdtemp(prefix="apex_tpu_elastic_bench_")
+    try:
+        ckpt = os.path.join(run_dir, "ckpt")
+        losses = os.path.join(run_dir, "losses.txt")
+
+        def build_cmd(host, w, incarnation):
+            return [_sys.executable, host_program,
+                    "--host", host, "--world", w, "--steps", steps,
+                    "--root", ckpt, "--losses", losses,
+                    "--heartbeat-dir", os.path.join(run_dir, "hb"),
+                    "--save-every", save_every,
+                    "--barrier-timeout", 60, "--step-sleep", 0.1]
+
+        def host_env(host, w, incarnation):
+            env = {"PYTHONPATH": repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   "JAX_PLATFORMS": "cpu"}
+            if incarnation == 0 and host == world - 1:
+                env["APEX_TPU_ELASTIC_CHAOS"] = f"kill@{kill_at}"
+            return env
+
+        sup = Supervisor(build_cmd, world,
+                         heartbeat_dir=os.path.join(run_dir, "hb"),
+                         heartbeat_timeout_s=120.0,
+                         startup_timeout_s=120.0, max_restarts=2,
+                         host_env=host_env)
+        t0 = time.perf_counter()
+        summary = sup.run()
+        wall_s = time.perf_counter() - t0
+        records = {}
+        with open(losses) as f:
+            for line in f:
+                if line.startswith("S "):
+                    _, s, hexval = line.split()
+                    records[int(s)] = hexval
+        ref, _ = reference_records(world, steps)
+        if records != ref:
+            raise RuntimeError(
+                "elastic_mttr: post-kill loss records diverged from "
+                "the uninterrupted reference — refusing to publish")
+        mttr = (summary["incidents"][0]["recovery_s"]
+                if summary["incidents"] else None)
+        return {"elastic_mttr": {
+            "world": world, "steps": steps, "save_every": save_every,
+            "kill_at": kill_at,
+            "mttr_s": mttr,
+            "restarts": summary["restarts"],
+            "records_match": True,
+            "bare_step_ms": round(bare_s * 1e3, 3),
+            "saved_step_ms": round(saved_s * 1e3, 3),
+            "save_overhead_pct": round(overhead_pct, 2),
+            # the fixed inline cost of one save (snapshot dispatch +
+            # prev-save barrier residual + commit), amortization-free
+            "save_cost_ms_per_save": round(
+                (saved_s - bare_s) * save_every * 1e3, 2),
+            "supervised_wall_s": round(wall_s, 2),
+            "backend": jax.default_backend(),
+        }}
+    finally:
+        _sh.rmtree(run_dir, ignore_errors=True)
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -2023,6 +2176,24 @@ def main() -> None:
             print(f"grad lifecycle bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # elastic_mttr leg: the ISSUE-15 elastic-service costs — supervised
+    # host-kill MTTR + two-phase save/commit overhead A/B. Spawns fake-
+    # host subprocesses (a few jax startups), so fast mode skips it
+    # unless BENCH_ELASTIC=1 forces it (the CPU smoke configuration;
+    # artifact committed under bench_artifacts/). BENCH_ELASTIC=0
+    # skips everywhere.
+    elastic_mttr = None
+    want_elastic = os.environ.get("BENCH_ELASTIC")
+    if want_elastic != "0" and (not fast or want_elastic == "1"):
+        try:
+            elastic_mttr = _retry_transient(
+                bench_elastic_mttr, tag="elastic mttr leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"elastic mttr bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -2096,6 +2267,7 @@ def main() -> None:
         "prefix_reuse": (prefix_reuse or {}).get("prefix_reuse"),
         "spec_decode": (spec_decode or {}).get("spec_decode"),
         "grad_lifecycle": (grad_lifecycle or {}).get("grad_lifecycle"),
+        "elastic_mttr": (elastic_mttr or {}).get("elastic_mttr"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

@@ -70,6 +70,12 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("grad_lifecycle_bytes_ratio", "grad_lifecycle.bytes_ratio", False),
     ("grad_lifecycle_steps_per_sec",
      "grad_lifecycle.flat.steps_per_sec", True),
+    # ISSUE-15 elastic training service: time-to-resume after a host
+    # kill (restart + restore + rendezvous) and the per-step cost of
+    # the armed two-phase save/commit machinery
+    ("elastic_mttr_s", "elastic_mttr.mttr_s", False),
+    ("elastic_save_overhead_pct",
+     "elastic_mttr.save_overhead_pct", False),
 )
 
 # legs whose expected value is ~0, where a relative threshold would turn
@@ -82,6 +88,12 @@ ABS_TOLERANCE = {
     # so ONE lost request must regress — a relative threshold over a
     # zero base would wave any count through (or inf-flag noise)
     "fleet_requests_lost": 0.5,  # requests (docs/serving.md fleet)
+    # CPU MTTR is dominated by interpreter+jax startup (seconds of
+    # noise on a loaded host); the overhead pct carries the tensorstore
+    # per-save commit latency against a ~50ms simulated step, which
+    # swings with host load — gate drift, not noise
+    "elastic_mttr_s": 5.0,  # seconds (docs/resilience.md elastic)
+    "elastic_save_overhead_pct": 12.0,  # percentage points
 }
 
 # op-breakdown category diffing (ISSUE-9): a run whose *shape* of device

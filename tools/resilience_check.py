@@ -16,6 +16,22 @@ the repo's own machinery, small enough for the tier-1 CPU lane:
                        step — atomicity + typed-corruption fallback.
 - ``watchdog``         a stalled wait trips the hang watchdog with an
                        all-thread stack dump instead of hanging.
+- ``elastic_resume``   a W=4 two-phase-committed checkpoint (flat
+                       packed FusedAdam + GradBuckets state, sharded by
+                       rows across 4 manager instances) restores onto a
+                       W'=2 world: the re-flattened state continues the
+                       loss records BYTE-identically to an
+                       uninterrupted W'=2 run, ``check_pack_spec(spec,
+                       shard_count=2)`` is clean, and a newer
+                       MARKERLESS step (a torn multi-host save) is
+                       skipped with a ``checkpoint_fallback`` event —
+                       never restored.
+- ``host_kill``        a supervised 2-fake-host world (real
+                       subprocesses) suffers a SIGKILL mid-run; the
+                       supervisor detects the death, restarts the
+                       world, auto-resume picks up from a COMMITTED
+                       step > 0, and every loss record matches the
+                       uninterrupted reference byte-for-byte.
 
 Usage::
 
@@ -185,10 +201,151 @@ def check_watchdog() -> dict:
             "hang_events": len(hang_events)}
 
 
+def check_elastic_resume() -> dict:
+    """W=4 committed shards -> W'=2 world: bit-identical continuation,
+    shard-clean new layout, markerless garbage never restored."""
+    import jax
+    import json as _json
+
+    from apex_tpu import analysis
+    from apex_tpu.resilience import ElasticCheckpointManager, capture
+    from apex_tpu.resilience._elastic_host import (
+        build_world, init_params, reference_records,
+    )
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    root = tempfile.mkdtemp(prefix="apex_tpu_elastic_check_")
+    try:
+        W, W2, head, total = 4, 2, 4, 8
+        # head of the run at W=4, committed via 4 manager instances
+        ref_head, head_state = reference_records(W, head)
+        rec = RingBufferRecorder()
+        mgrs = [ElasticCheckpointManager(root, host=h, world=W, sink=rec,
+                                         barrier_timeout_s=30.0)
+                for h in range(W)]
+        for m in mgrs[1:]:
+            m.save(head_state, blocking=False)  # wait for COMMIT async
+        mgrs[0].save(head_state, blocking=True)
+        for m in mgrs[1:]:
+            m.wait_until_finished()
+
+        # a TORN newer save: one shard landed, no COMMIT marker
+        torn = os.path.join(root, "step_00000006", "shard-1.part")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "meta.json"), "w") as f:
+            _json.dump({"step": 6, "host": 1, "world": W,
+                        "pid": os.getpid()}, f)
+
+        # restore onto the SHRUNK world
+        def fresh2():
+            p, b2, o2, s2 = build_world(W2)
+            return capture(0, p, o2.init(p), scaler=s2.init_state(),
+                           rng=jax.random.PRNGKey(42),
+                           data={"position": 0})
+
+        m2 = ElasticCheckpointManager(root, host=0, world=W2, sink=rec,
+                                      barrier_timeout_s=30.0)
+        restored = m2.restore(fresh2())
+        resumed_from = int(restored.step) if restored else None
+        spec2 = restored.opt_state.spec if restored else None
+        findings = (analysis.check_pack_spec(spec2, shard_count=W2)
+                    if spec2 is not None else ["no spec"])
+        tail, _ = reference_records(W2, total, start_state=restored)
+        ref_all, _ = reference_records(W2, total)
+        events = [r["event"] for r in rec.records]
+        fallbacks = [r for r in rec.records
+                     if r["event"] == "checkpoint_fallback"]
+        ok = (resumed_from == head
+              and not findings
+              and {**ref_head, **tail} == ref_all
+              and any(r.get("step") == 6 for r in fallbacks)
+              and "checkpoint_reshard" in events)
+        return {"ok": ok, "resumed_from": resumed_from,
+                "spec_findings": [str(f) for f in findings],
+                "records_match": {**ref_head, **tail} == ref_all,
+                "markerless_skipped": any(r.get("step") == 6
+                                          for r in fallbacks),
+                "resharded": "checkpoint_reshard" in events,
+                "events": events}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def check_host_kill() -> dict:
+    """Supervised 2-host world + SIGKILL: restart, resume from a
+    committed step, byte-identical loss records."""
+    import sys as _sys
+
+    from apex_tpu.resilience import Supervisor
+    from apex_tpu.resilience._elastic_host import reference_records
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    host_program = os.path.join(repo, "apex_tpu", "resilience",
+                                "_elastic_host.py")
+    run_dir = tempfile.mkdtemp(prefix="apex_tpu_host_kill_")
+    try:
+        ckpt = os.path.join(run_dir, "ckpt")
+        losses = os.path.join(run_dir, "losses.txt")
+        steps, world = 8, 2
+
+        def build_cmd(host, w, incarnation):
+            return [_sys.executable, host_program,
+                    "--host", host, "--world", w, "--steps", steps,
+                    "--root", ckpt, "--losses", losses,
+                    "--heartbeat-dir", os.path.join(run_dir, "hb"),
+                    "--save-every", 2, "--barrier-timeout", 30,
+                    "--step-sleep", 0.1]
+
+        def host_env(host, w, incarnation):
+            env = {"PYTHONPATH": repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   "JAX_PLATFORMS": "cpu"}
+            if incarnation == 0 and host == 1:
+                env["APEX_TPU_ELASTIC_CHAOS"] = "kill@7"
+            return env
+
+        rec = RingBufferRecorder()
+        sup = Supervisor(build_cmd, world,
+                         heartbeat_dir=os.path.join(run_dir, "hb"),
+                         heartbeat_timeout_s=60.0,
+                         startup_timeout_s=120.0, max_restarts=2,
+                         sink=rec, host_env=host_env)
+        summary = sup.run()
+
+        # parse host 0's appended records; find the restart point
+        seq, records = [], {}
+        with open(losses) as f:
+            for line in f:
+                if line.startswith("S "):
+                    _, s, hexval = line.split()
+                    seq.append(int(s))
+                    records[int(s)] = hexval
+        resume_points = [seq[i + 1] for i in range(len(seq) - 1)
+                         if seq[i + 1] <= seq[i]]
+        resumed_from_commit = bool(resume_points) and min(
+            resume_points) > 0
+        ref, _ = reference_records(world, steps)
+        ok = (summary["ok"] and summary["restarts"] == 1
+              and summary["incidents"][0]["kind"] == "host_death"
+              and resumed_from_commit
+              and records == ref)
+        return {"ok": ok, "restarts": summary["restarts"],
+                "incidents": summary["incidents"],
+                "resume_points": resume_points,
+                "resumed_from_commit": resumed_from_commit,
+                "records_match": records == ref,
+                "n_records": len(records)}
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 CHECKS = {
     "nan_rewind": check_nan_rewind,
     "failed_write": check_failed_write,
     "watchdog": check_watchdog,
+    "elastic_resume": check_elastic_resume,
+    "host_kill": check_host_kill,
 }
 
 
