@@ -108,20 +108,46 @@ def _is_bn_path(path) -> bool:
     return any(m in s for m in _BN_MARKERS)
 
 
+def _cast_preserving_sharding(x, dtype):
+    """``astype`` that keeps a committed leaf's placement.
+
+    Already-target-dtype leaves return ``x`` itself — the zero-copy
+    identity the re-cast path relies on (pinned by test), now explicit
+    rather than delegated to ``astype``. Otherwise cast and, if the
+    leaf carried a ``NamedSharding`` the result lost (an eager cast of
+    a mesh-sharded leaf must stay on its mesh — a TP engine's
+    column/row-parallel weight slices would otherwise implicitly gather
+    to one device), pin the result back under the input's sharding.
+    """
+    if getattr(x, "dtype", None) == dtype:
+        return x
+    y = x.astype(dtype)
+    in_sh = getattr(x, "sharding", None)
+    if (isinstance(in_sh, jax.sharding.NamedSharding)
+            and isinstance(x, jax.Array)
+            and not isinstance(x, jax.core.Tracer)
+            and not y.sharding.is_equivalent_to(in_sh, x.ndim)):
+        y = jax.device_put(y, in_sh)
+    return y
+
+
 def cast_model(params: Pytree, dtype, keep_batchnorm_fp32: bool) -> Pytree:
     """Cast float params to ``dtype``; optionally keep batchnorm-ish leaves fp32.
 
     The batchnorm test is a key-path heuristic (flax/haiku module names),
     standing in for the reference's module-class walk
-    (``apex/fp16_utils/fp16util.py`` ``convert_network``).
+    (``apex/fp16_utils/fp16util.py`` ``convert_network``). Each leaf is
+    cast under its own sharding (:func:`_cast_preserving_sharding`), so
+    a mesh-sharded tree comes back sharded the same way.
     """
+    dtype = jnp.dtype(dtype)
 
     def leaf(path, x):
         if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
             return x
         if keep_batchnorm_fp32 and _is_bn_path(path):
-            return x.astype(jnp.float32)
-        return x.astype(dtype)
+            return _cast_preserving_sharding(x, jnp.dtype(jnp.float32))
+        return _cast_preserving_sharding(x, dtype)
 
     return jax.tree_util.tree_map_with_path(leaf, params)
 

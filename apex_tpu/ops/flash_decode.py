@@ -32,6 +32,15 @@ Layouts (head-major pages — keeps the in-kernel dots transpose-free):
 Rows with ``kv_lens == 0`` output zeros (the training kernels'
 fully-masked-row convention, ``flash_attention.py``).
 
+**Tensor parallelism** (``serving/engine.py``, TP engines): heads are a
+pure batch dimension here — nothing in the grid, the online-softmax
+recurrence, or the page DMA ever mixes two heads. A head-sharded pool
+(``PagedKVSpec.shard(tp)``) therefore needs NO kernel changes: each
+shard runs this identical kernel over its local ``n_heads / tp`` head
+slice of q and of every page, and the per-head attention outputs are
+already final (the cross-shard ``psum`` lives in the projection GEMM
+tail that follows, not in attention).
+
 Like ``packed_optimizer.py``, every entry point has an XLA fallback
 (``use_kernel=False``, auto-selected off-TPU) computing identical fp32
 math via a gather, and the kernel body runs under the Pallas interpreter

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 
 from ..ops.flash_decode import flash_decode
 from ..ops.layer_norm import layer_norm as fused_layer_norm
+from ..transformer import parallel_state
 from .kv_cache import (
     KVCacheState,
     PagedKVSpec,
@@ -62,6 +63,15 @@ def _ln(x, w, b, eps):
         b.astype(jnp.float32), eps=eps)
 
 
+def _psum_tail(x, tp_axis):
+    """The row-parallel sublayer tail: all-reduce the partial GEMM over
+    the tensor axis (Megatron ``RowParallelLinear`` forward). With
+    ``tp_axis=None`` (the replicated engine) this is the identity and
+    the traced program is unchanged. Exactly one per sublayer — the
+    jaxpr psum-count pin counts these."""
+    return x if tp_axis is None else jax.lax.psum(x, tp_axis)
+
+
 def decode_tokens(
     cfg,
     params: Pytree,
@@ -74,6 +84,7 @@ def decode_tokens(
     *,
     use_kernel: Optional[bool] = None,
     interpret: bool = False,
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, KVCacheState]:
     """One decode step: embed, run every layer against the paged cache
     (appending this token's K/V in place), return fp32 logits
@@ -82,6 +93,12 @@ def decode_tokens(
     Inactive slots are fully select-gated: token/position 0, writes to
     the garbage page, zero attention — their logits are garbage and the
     caller masks them.
+
+    With ``tp_axis`` (inside the TP engine's ``shard_map``): ``spec``
+    is the LOCAL head-sharded spec, ``params`` carry per-shard
+    column/row-parallel weight slices, the same per-layer math runs on
+    ``n/tp`` heads, one :func:`_psum_tail` closes each sublayer, and
+    the returned logits are the shard's ``[B, vocab/tp]`` slice.
     """
     B = tokens.shape[0]
     n, d, ps = spec.num_heads, spec.head_dim, spec.page_size
@@ -126,8 +143,8 @@ def decode_tokens(
             use_kernel=use_kernel, interpret=interpret,
         ).astype(dt)
 
-        attn = (jnp.einsum("bo,ho->bh", ctx.reshape(B, n * d),
-                           lp["proj_w"].astype(dt))
+        attn = (_psum_tail(jnp.einsum("bo,ho->bh", ctx.reshape(B, n * d),
+                                      lp["proj_w"].astype(dt)), tp_axis)
                 + lp["proj_b"].astype(dt))
         h = (h + attn).astype(dt)
 
@@ -135,20 +152,15 @@ def decode_tokens(
         inter = (jnp.einsum("bh,oh->bo", ln2, lp["fc1_w"].astype(dt))
                  + lp["fc1_b"].astype(dt))
         inter = jax.nn.gelu(inter, approximate=True)
-        mlp = (jnp.einsum("bo,ho->bh", inter, lp["fc2_w"].astype(dt))
+        mlp = (_psum_tail(jnp.einsum("bo,ho->bh", inter,
+                                     lp["fc2_w"].astype(dt)), tp_axis)
                + lp["fc2_b"].astype(dt))
         h = (h + mlp).astype(dt)
         return (h, pages)
 
     h, pages = jax.lax.fori_loop(0, L, layer_body, (h, kv.pages))
 
-    h = _ln(h, params["final_ln_w"], params["final_ln_b"],
-            eps).astype(compute)
-    # tied-embedding head, fp32 logits (training `_lm_head` parity)
-    logits = jnp.einsum(
-        "bh,vh->bv", h, params["embedding"]["word"].astype(compute),
-        preferred_element_type=jnp.float32,
-    )
+    logits = lm_logits(cfg, params, h, tp_axis=tp_axis)
     return logits, KVCacheState(pages=pages)
 
 
@@ -164,6 +176,7 @@ def chunk_hidden(
     *,
     use_kernel: Optional[bool] = None,
     interpret: bool = False,
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """The chunk-shaped transformer body shared by chunked prefill and
     speculative verification: embed a ``[B, C]`` token grid, scatter
@@ -219,7 +232,8 @@ def chunk_hidden(
             use_kernel=use_kernel, interpret=interpret,
         ).reshape(B, C, n * d).astype(dt)
 
-        attn = (jnp.einsum("bco,ho->bch", ctx, lp["proj_w"].astype(dt))
+        attn = (_psum_tail(jnp.einsum("bco,ho->bch", ctx,
+                                      lp["proj_w"].astype(dt)), tp_axis)
                 + lp["proj_b"].astype(dt))
         h = (h + attn).astype(dt)
 
@@ -227,7 +241,8 @@ def chunk_hidden(
         inter = (jnp.einsum("bch,oh->bco", ln2, lp["fc1_w"].astype(dt))
                  + lp["fc1_b"].astype(dt))
         inter = jax.nn.gelu(inter, approximate=True)
-        mlp = (jnp.einsum("bco,ho->bch", inter, lp["fc2_w"].astype(dt))
+        mlp = (_psum_tail(jnp.einsum("bco,ho->bch", inter,
+                                     lp["fc2_w"].astype(dt)), tp_axis)
                + lp["fc2_b"].astype(dt))
         h = (h + mlp).astype(dt)
         return (h, pages)
@@ -236,16 +251,32 @@ def chunk_hidden(
     return h, pages
 
 
-def lm_logits(cfg, params: Pytree, h: jax.Array) -> jax.Array:
+def lm_logits(cfg, params: Pytree, h: jax.Array, *,
+              tp_axis: Optional[str] = None) -> jax.Array:
     """Final LN + tied-embedding head, fp32 logits (training
     ``_lm_head`` parity). ``h`` is ``[..., hidden]``; the vocab GEMM
-    runs over whatever leading shape the caller kept."""
+    runs over whatever leading shape the caller kept.
+
+    With ``tp_axis`` the head is VOCAB-parallel: the word embedding
+    stays replicated (the input lookup is a plain local take — no
+    embedding psum, which is what keeps the psum-count pin at one per
+    sublayer tail), and each shard contracts only its
+    ``vocab/tp``-row slice, returning local ``[..., vocab/tp]``
+    logits. Each output logit is an independent dot product, so the
+    shard's slice is bitwise the replicated head's — no collective
+    here; the cross-shard reduction lives in the sampler.
+    """
     compute = cfg.compute_dtype
     h = _ln(h, params["final_ln_w"], params["final_ln_b"],
             cfg.layernorm_epsilon).astype(compute)
+    word = params["embedding"]["word"]
+    if tp_axis is not None:
+        tp = parallel_state.axis_size(tp_axis)
+        vl = word.shape[0] // tp
+        word = jax.lax.dynamic_slice_in_dim(
+            word, jax.lax.axis_index(tp_axis) * vl, vl, axis=0)
     return jnp.einsum(
-        "...h,vh->...v", h,
-        params["embedding"]["word"].astype(compute),
+        "...h,vh->...v", h, word.astype(compute),
         preferred_element_type=jnp.float32,
     )
 
@@ -265,6 +296,7 @@ def prefill_chunk_tokens(
     chunk: int,
     use_kernel: Optional[bool] = None,
     interpret: bool = False,
+    tp_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, KVCacheState, jax.Array]:
     """One CHUNKED step: each prefilling slot consumes
     ``min(chunk, prompt_len - pos)`` prompt tokens (a dynamic slice of
@@ -303,14 +335,14 @@ def prefill_chunk_tokens(
 
     h, pages = chunk_hidden(cfg, params, spec, kv, tok, pclamp, valid,
                             page_tables, use_kernel=use_kernel,
-                            interpret=interpret)
+                            interpret=interpret, tp_axis=tp_axis)
 
     # only the LAST consumed column's logits matter (the emission
     # point); select it before the vocab GEMM — one [B, vocab] head
     # instead of C of them
     last = jnp.maximum(take - 1, 0)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
-    logits = lm_logits(cfg, params, h_last)
+    logits = lm_logits(cfg, params, h_last, tp_axis=tp_axis)
     return logits, KVCacheState(pages=pages), take
 
 

@@ -70,10 +70,13 @@ from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import telemetry
 from ..amp import cast_params_for_inference
 from ..ops.flash_decode import _kernel_ok, flash_decode_available
+from ..transformer import parallel_state
 from .decode_model import (  # noqa: F401
     decode_tokens,
     prefill_chunk_tokens,
@@ -94,8 +97,9 @@ from .robustness import (
     recover_requests,
     request_expired,
 )
+from .sampling import TOP_FILTER_WIDTH
 from .sampling import i32_wrap as _i32_wrap
-from .sampling import resolve, sample_tokens
+from .sampling import resolve, sample_tokens, sample_tokens_tp
 from .scheduler import Request, Scheduler, SchedulerError
 from .spec_decode import (  # noqa: F401  (sentinel re-export: the
     NO_TOKEN,  # fetched array carries tokens AND the per-slot fault
@@ -181,6 +185,8 @@ class ServingEngine:
         prefix_cache: bool = True,
         spec_k: int = 0,
         spec_ngram: int = 3,
+        tp: int = 1,
+        devices: Optional[Sequence[Any]] = None,
     ):
         # recovery (recover_from) rebuilds an engine with the same
         # geometry/policies; capture the kwargs before unpacking
@@ -193,10 +199,26 @@ class ServingEngine:
             degradation=degradation, watchdog=watchdog,
             step_timeout_s=step_timeout_s, chaos=chaos, clock=clock,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-            spec_k=spec_k, spec_ngram=spec_ngram)
+            spec_k=spec_k, spec_ngram=spec_ngram, tp=tp, devices=devices)
         self.cfg = cfg
         n, d = cfg.num_attention_heads, cfg.kv_channels
-        ps = page_size or default_page_size(n, d)
+        #: tensor-parallel degree. tp > 1 head-shards the paged KV pool
+        #: and column/row-shards the GEMMs over a single-axis
+        #: ``(tensor,)`` submesh; the host half (scheduler, page
+        #: tables, admission, prefix cache) is untouched — slot state
+        #: stays replicated and the emitted-token fetch stays the one
+        #: host sync per step.
+        self.tp = int(tp)
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if n % self.tp:
+            raise ValueError(
+                f"num_attention_heads {n} not divisible by tp={self.tp}")
+        # a TP engine's K/V page must be ROW-aligned PER SHARD (each
+        # shard holds n/tp heads of every page), so the default page
+        # size derives from the LOCAL head count — spec.shard() below
+        # re-validates whatever the caller forces
+        ps = page_size or default_page_size(n // self.tp, d)
         max_seq = cfg.max_position_embeddings
         # mp*ps may overshoot max_seq (pages quantize); submit() holds
         # requests to max_position_embeddings either way
@@ -210,6 +232,36 @@ class ServingEngine:
         # the on-device prompt buffer must hold preemption-replay
         # prompts (original prompt + generated so far): cap = max seq
         self._buf_len = min(self.spec.max_seq_len, max_seq)
+        #: the per-shard spec the traced programs see (``== self.spec``
+        #: at tp=1): n/tp heads, same page geometry — one chunk-aligned
+        #: PackSpec per shard. Host logic keeps using the GLOBAL spec.
+        self.spec_local = self.spec.shard(self.tp)
+        self._mesh = None
+        self._psum_counts: Optional[Dict[str, int]] = None
+        if self.tp > 1:
+            # mechanical layout gate: the global flat pool must divide
+            # into tp ROW-aligned extents (the per-shard PackSpec the
+            # local spec's own constructor already validated)
+            from ..analysis.rules import check_pack_spec
+            findings = check_pack_spec(self.spec.pack_spec,
+                                       shard_count=self.tp,
+                                       where="serving_kv_pool")
+            if findings:
+                raise ValueError(
+                    "KV pool layout is not tp-shardable: "
+                    + "; ".join(f"{f.code}: {f.message}" for f in findings))
+            vocab = int(params["embedding"]["word"].shape[0])
+            if vocab % self.tp:
+                raise ValueError(
+                    f"vocab {vocab} not divisible by tp={self.tp} "
+                    "(lm_logits is vocab-parallel)")
+            self._mesh = parallel_state.tp_submesh(self.tp,
+                                                   devices=devices)
+            # weights onto the mesh BEFORE the cast — the cast
+            # preserves each leaf's NamedSharding, so the column/row
+            # slices are laid down exactly once
+            params = jax.device_put(params,
+                                    self._tp_param_shardings(params))
         # one-shot inference cast through the amp tables: bf16/fp16
         # weights for a low-precision compute dtype, no master copies
         self.params = cast_params_for_inference(params, cfg.compute_dtype)
@@ -269,9 +321,9 @@ class ServingEngine:
         self.watchdog = watchdog
         self._step_timeout_s = step_timeout_s
         self._clock = clock if clock is not None else time.perf_counter
-        self.kv = self.spec.init_cache()
-        self.slots = self._init_slots()
-        self.metrics = telemetry.init_metrics()
+        self.kv = self._place_kv(self.spec.init_cache())
+        self.slots = self._replicated(self._init_slots())
+        self.metrics = self._replicated(telemetry.init_metrics())
         self._step = self._build_step()
         # the chunked-prefill program (built lazily on first use): same
         # carry, same donation, up to `prefill_chunk` prompt tokens per
@@ -286,7 +338,8 @@ class ServingEngine:
         self._copy_pages = jax.jit(_copy_pool_pages, donate_argnums=(0,))
         self._mutate = jax.jit(_mutate_slots, donate_argnums=(0,))
         self._occupants: List[Optional[int]] = [None] * self.n_slots
-        self._no_poison = jnp.zeros((self.n_slots,), bool)
+        self._no_poison = self._replicated(
+            jnp.zeros((self.n_slots,), bool))
         self.steps_run = 0
         self.last_stats: Dict[str, Any] = {}
         self._accum = self._fresh_accum()
@@ -343,36 +396,141 @@ class ServingEngine:
             hist=jnp.zeros((B, W + 1), jnp.int32),
         )
 
+    # -- tensor parallelism ------------------------------------------------
+    @property
+    def _tp_axis(self) -> Optional[str]:
+        """The named axis the traced programs reduce over (None = the
+        replicated single-chip engine; the code paths are identical)."""
+        return parallel_state.TENSOR_AXIS if self.tp > 1 else None
+
+    def _tp_param_pspecs(self, params):
+        """PartitionSpec tree for the Megatron serving sharding map:
+        QKV/fc1 column-parallel (head-major out dim — whole heads per
+        shard, matching the pool's head shard), proj/fc2 row-parallel
+        (contraction dim; their psum is the sublayer tail), everything
+        else — LNs, both embeddings, row-parallel biases — replicated.
+        The word embedding stays replicated on purpose: the input
+        lookup is a plain local take, and only ``lm_logits`` slices it
+        vocab-parallel (no embedding psum)."""
+        t = parallel_state.TENSOR_AXIS
+        col = {
+            "qkv_w": PartitionSpec(None, t, None),
+            "qkv_b": PartitionSpec(None, t),
+            "fc1_w": PartitionSpec(None, t, None),
+            "fc1_b": PartitionSpec(None, t),
+            "proj_w": PartitionSpec(None, None, t),
+            "fc2_w": PartitionSpec(None, None, t),
+        }
+
+        def leaf_spec(path, x):
+            last = path[-1]
+            name = last.key if hasattr(last, "key") else str(last)
+            return col.get(name, PartitionSpec())
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+    def _tp_param_shardings(self, params):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self._mesh, s),
+            self._tp_param_pspecs(params),
+            is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+    def _kv_pspec(self) -> PartitionSpec:
+        """Pool ``[L, 2, pages, heads, page, dim]``: head-sharded."""
+        return PartitionSpec(None, None, None, parallel_state.TENSOR_AXIS,
+                             None, None)
+
+    def _replicated(self, tree):
+        """Pin host-carried state (slots/metrics/poison) replicated on
+        the TP mesh, so donation in == out and no step reshards it."""
+        if self._mesh is None:
+            return tree
+        sh = NamedSharding(self._mesh, PartitionSpec())
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh),
+                                      tree)
+
+    def _place_kv(self, kv: KVCacheState) -> KVCacheState:
+        if self._mesh is None:
+            return kv
+        return jax.device_put(
+            kv, NamedSharding(self._mesh, self._kv_pspec()))
+
+    def _maybe_shard_map(self, core, n_rep: int):
+        """Wrap a step core ``core(params, kv, *rep_args) -> (kv,
+        slots, emitted)`` in ``shard_map`` over the TP mesh — the
+        identity at tp=1, so the replicated engine's traced program is
+        exactly the historical one. ``check_rep=False`` is the ddp_step
+        precedent: slot math runs redundantly per shard on replicated
+        inputs and collectives keep it bitwise identical across shards,
+        which vma tracking cannot see."""
+        if self._mesh is None:
+            return core
+        rep = PartitionSpec()
+        return shard_map(
+            core, mesh=self._mesh,
+            in_specs=(self._tp_param_pspecs(self.params),
+                      self._kv_pspec()) + (rep,) * n_rep,
+            out_specs=(self._kv_pspec(), rep, rep),
+            check_rep=False)
+
+    def program_psum_counts(self) -> Optional[Dict[str, int]]:
+        """Textual jaxpr psum count per enabled serving program (None
+        at tp=1 — there are no collectives to count). The fori_loop
+        layer body appears once, so each program counts its two
+        sublayer tails plus the sampler's one fused reduction = 3 —
+        the number the psum-pin test and ``_summarize`` report."""
+        if self.tp == 1:
+            return None
+        if self._psum_counts is None:
+            progs = [("decode", self.step_program())]
+            if self.prefill_chunk > 1:
+                progs.append(("chunk_prefill", self.chunk_step_program()))
+            if self.spec_k > 0:
+                progs.append(("spec_verify", self.spec_step_program()))
+            self._psum_counts = {
+                name: str(jax.make_jaxpr(fn)(*args)).count("psum")
+                for name, (fn, args) in progs}
+        return self._psum_counts
+
     def _build_step(self):
-        cfg, spec = self.cfg, self.spec
+        cfg, spec = self.cfg, self.spec_local
         buf_len = self._buf_len
         use_kernel, interpret = self._use_kernel, self._interpret
         tel_every, sink = self.telemetry_every, self.sink
+        axis, vocab = self._tp_axis, self.cfg.vocab_size
 
-        def step(params, kv, slots, page_tables, poison, metrics):
+        def core(params, kv, slots, page_tables, poison):
             logits, kv = decode_tokens(
                 cfg, params, spec, kv, slots.tokens, slots.positions,
                 slots.active, page_tables,
-                use_kernel=use_kernel, interpret=interpret)
+                use_kernel=use_kernel, interpret=interpret,
+                tp_axis=axis)
             # chaos seam: the poison mask turns a slot's logits
             # non-finite IN-JIT (the shape of a corrupted activation /
             # poisoned weight shard) — one compiled program serves the
             # armed and unarmed arms, like resilience.poison_grads
             logits = jnp.where(poison[:, None], jnp.float32(jnp.nan),
                                logits)
-            # fault isolation: per-slot non-finite check on the SAME
-            # logits read the argmax consumes. `bad` rides the emitted
-            # vector as the POISONED sentinel, so quarantine costs no
-            # extra host sync.
-            bad = slots.active & ~jnp.all(jnp.isfinite(logits), axis=-1)
             # the carried sampler: greedy rows are the exact argmax
             # (byte-identical to the pre-sampling engine); sampled rows
             # draw via the (seed, rid, position) hash counter — the
             # emitted token OCCUPIES position pos + 1, which is its
-            # PRNG key
-            sampled = sample_tokens(
-                logits, slots.temps, slots.top_ks, slots.top_ps,
-                slots.seeds, slots.rids, slots.positions + 1)
+            # PRNG key. Fault isolation rides along: the per-slot
+            # non-finite check on the SAME logits the argmax consumes
+            # becomes the POISONED sentinel — no extra host sync (and
+            # under TP it shares the sampler's one fused psum).
+            if axis is None:
+                bad = (slots.active
+                       & ~jnp.all(jnp.isfinite(logits), axis=-1))
+                sampled = sample_tokens(
+                    logits, slots.temps, slots.top_ks, slots.top_ps,
+                    slots.seeds, slots.rids, slots.positions + 1)
+            else:
+                sampled, nonfin = sample_tokens_tp(
+                    logits, slots.temps, slots.top_ks, slots.top_ps,
+                    slots.seeds, slots.rids, slots.positions + 1,
+                    axis_name=axis, vocab_size=vocab)
+                bad = slots.active & nonfin
             next_pos = slots.positions + 1
             still_prefill = next_pos < slots.prompt_lens
             prompt_next = jnp.take_along_axis(
@@ -389,6 +547,15 @@ class ServingEngine:
                 positions=jnp.where(slots.active, next_pos,
                                     slots.positions),
             )
+            return kv, slots, emitted
+
+        # telemetry stays OUTSIDE the shard_map: the drain's cond-gated
+        # host callback must trace once per program, not once per shard
+        core = self._maybe_shard_map(core, n_rep=3)
+
+        def step(params, kv, slots, page_tables, poison, metrics):
+            kv, slots, emitted = core(params, kv, slots, page_tables,
+                                      poison)
             if tel_every > 0:
                 metrics = telemetry.accumulate(
                     metrics,
@@ -407,27 +574,37 @@ class ServingEngine:
         token). Selected by :meth:`run_step` whenever any slot is
         prefilling; mixed prefill/decode steps therefore stay ONE
         fixed-shape program."""
-        cfg, spec = self.cfg, self.spec
+        cfg, spec = self.cfg, self.spec_local
         buf_len = self._buf_len
         chunk = self.prefill_chunk
         use_kernel, interpret = self._use_kernel, self._interpret
         tel_every, sink = self.telemetry_every, self.sink
+        axis, vocab = self._tp_axis, self.cfg.vocab_size
 
-        def step(params, kv, slots, page_tables, poison, metrics):
+        def core(params, kv, slots, page_tables, poison):
             logits, kv, take = prefill_chunk_tokens(
                 cfg, params, spec, kv, slots.tokens, slots.positions,
                 slots.active, slots.prompt_buf, slots.prompt_lens,
                 page_tables, chunk=chunk,
-                use_kernel=use_kernel, interpret=interpret)
+                use_kernel=use_kernel, interpret=interpret,
+                tp_axis=axis)
             logits = jnp.where(poison[:, None], jnp.float32(jnp.nan),
                                logits)
-            bad = slots.active & ~jnp.all(jnp.isfinite(logits), axis=-1)
             next_pos = slots.positions + take
             # the emission point's logits produce the token that will
             # OCCUPY position pos + take — its PRNG key
-            sampled = sample_tokens(
-                logits, slots.temps, slots.top_ks, slots.top_ps,
-                slots.seeds, slots.rids, next_pos)
+            if axis is None:
+                bad = (slots.active
+                       & ~jnp.all(jnp.isfinite(logits), axis=-1))
+                sampled = sample_tokens(
+                    logits, slots.temps, slots.top_ks, slots.top_ps,
+                    slots.seeds, slots.rids, next_pos)
+            else:
+                sampled, nonfin = sample_tokens_tp(
+                    logits, slots.temps, slots.top_ks, slots.top_ps,
+                    slots.seeds, slots.rids, next_pos,
+                    axis_name=axis, vocab_size=vocab)
+                bad = slots.active & nonfin
             still_prefill = next_pos < slots.prompt_lens
             prompt_next = jnp.take_along_axis(
                 slots.prompt_buf,
@@ -441,6 +618,13 @@ class ServingEngine:
                 positions=jnp.where(slots.active, next_pos,
                                     slots.positions),
             )
+            return kv, slots, emitted
+
+        core = self._maybe_shard_map(core, n_rep=3)
+
+        def step(params, kv, slots, page_tables, poison, metrics):
+            kv, slots, emitted = core(params, kv, slots, page_tables,
+                                      poison)
             if tel_every > 0:
                 metrics = telemetry.accumulate(
                     metrics,
@@ -465,19 +649,27 @@ class ServingEngine:
         ``NO_TOKEN`` padding, ``POISONED`` quarantine in column 0, the
         drafted-token count in the last column) — still ONE host sync
         per step."""
-        cfg, spec = self.cfg, self.spec
+        cfg, spec = self.cfg, self.spec_local
         spec_k, ngram = self.spec_k, self.spec_ngram
         chunk = self.prefill_chunk
         use_kernel, interpret = self._use_kernel, self._interpret
         tel_every, sink = self.telemetry_every, self.sink
+        axis = self._tp_axis
 
-        def step(params, kv, slots, page_tables, poison, draft_caps,
-                 metrics):
-            kv, slots, emitted = run_spec_step(
+        def core(params, kv, slots, page_tables, poison, draft_caps):
+            return run_spec_step(
                 cfg, params, spec, kv, slots, page_tables, poison,
                 draft_caps, spec_k=spec_k, ngram=ngram,
                 prefill_chunk=chunk,
-                use_kernel=use_kernel, interpret=interpret)
+                use_kernel=use_kernel, interpret=interpret,
+                tp_axis=axis)
+
+        core = self._maybe_shard_map(core, n_rep=4)
+
+        def step(params, kv, slots, page_tables, poison, draft_caps,
+                 metrics):
+            kv, slots, emitted = core(params, kv, slots, page_tables,
+                                      poison, draft_caps)
             if tel_every > 0:
                 metrics = telemetry.accumulate(
                     metrics,
@@ -529,6 +721,11 @@ class ServingEngine:
 
         fn, args = self.step_program()
         kw.setdefault("pack_specs", [self.spec.pack_spec])
+        if self.tp > 1:
+            # the pack-spec gate re-checks the pool layout against the
+            # engine's shard count — the audited programs ARE the
+            # shard_map-wrapped TP traces
+            kw.setdefault("shard_count", self.tp)
         report = assert_step_clean(
             fn, *args, name=kw.pop("name", "serving_decode_step"), **kw)
         if self.prefill_chunk > 1:
@@ -560,6 +757,18 @@ class ServingEngine:
             return RejectionReason(
                 RejectionCode.BAD_MAX_NEW,
                 f"request {req.rid}: max_new_tokens < 1")
+        if self.tp > 1:
+            sp = resolve(req.sampling)
+            # the TP sampler has no deep-top_k fallback: thresholds come
+            # from the gathered per-shard top-64 candidates, so a top_k
+            # beyond the filter width cannot be honored exactly —
+            # refuse at submit rather than silently truncate
+            if sp.top_k > TOP_FILTER_WIDTH:
+                return RejectionReason(
+                    RejectionCode.UNSUPPORTED_SAMPLING,
+                    f"request {req.rid}: top_k {sp.top_k} exceeds the "
+                    f"tensor-parallel filter width {TOP_FILTER_WIDTH} "
+                    f"(tp={self.tp} has no full-vocab sort fallback)")
         return None
 
     def probe(self, req: Request
@@ -1302,6 +1511,13 @@ class ServingEngine:
             "prefix_cache": self.prefix_cache_run_stats(),
             "prefill_step_time_s": round(a["prefill_step_time_s"], 4),
             "decode_step_time_s": round(a["decode_step_time_s"], 4),
+            # tensor-parallel geometry: per-shard pool footprint is the
+            # capacity-planning number (each chip holds heads/tp of
+            # every page), psum counts are the collective budget the
+            # jaxpr pin enforces (2 sublayer tails + 1 sampler psum)
+            "tp": self.tp,
+            "kv_bytes_per_shard": self.spec_local.cache_bytes(),
+            "psum_per_program": self.program_psum_counts(),
         }
 
     def prefix_cache_run_stats(self) -> Optional[Dict[str, Any]]:
@@ -1346,6 +1562,12 @@ class ServingEngine:
         stale entry surviving a hot swap would serve old-model prefixes
         under the new model — the fleet's ``try_join`` weight swap goes
         through here, which is what makes that impossible."""
+        if self._mesh is not None:
+            # lay the fresh weights down sharded BEFORE the cast (the
+            # cast preserves per-leaf shardings) — same order as the
+            # ctor, so a swap never round-trips slices through one chip
+            params = jax.device_put(params,
+                                    self._tp_param_shardings(params))
         self.params = cast_params_for_inference(params,
                                                 self.cfg.compute_dtype)
         if self.prefix_cache is not None:

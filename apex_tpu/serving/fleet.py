@@ -75,6 +75,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import telemetry
 from ..resilience.chaos import ChaosError
+from ..transformer import parallel_state
 from ..resilience.watchdog import HangError
 from .engine import ServingEngine
 from .robustness import (
@@ -154,6 +155,7 @@ class ReplicaFleet:
         params: Pytree,
         *,
         n_replicas: int = 2,
+        tp: int = 1,
         sink=None,
         clock: Optional[Callable[[], float]] = None,
         chaos=None,
@@ -163,16 +165,30 @@ class ReplicaFleet:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.cfg = cfg
+        #: DP×TP topology: the fleet's data-parallel axis is its
+        #: replica list (each replica an independent engine with its
+        #: own pool and scheduler), the tensor axis lives INSIDE each
+        #: engine — replica ``i`` shard_maps over TP device group ``i``
+        #: (``parallel_state.tp_submesh(tp, replica=i)``), so
+        #: ``n_replicas * tp`` chips serve with no cross-replica
+        #: collective. The router/migration/rolling-update machinery
+        #: is topology-blind: it only ever talks to engines.
+        self.tp = int(tp)
         self.sink = sink if sink is not None else telemetry.NullRecorder()
         self._clock = clock if clock is not None else time.perf_counter
         self._chaos = chaos
         self.migration_retry = migration_retry
         self.replicas: List[Replica] = []
         for i in range(n_replicas):
+            devs = (list(parallel_state.tp_submesh(
+                self.tp, replica=i).devices.reshape(-1))
+                if self.tp > 1 else None)
             eng = ServingEngine(
                 cfg, params,
-                sink=telemetry.TaggedRecorder(self.sink, replica_id=i),
-                clock=self._clock, chaos=chaos, **engine_kw)
+                sink=telemetry.TaggedRecorder(self.sink, replica_id=i,
+                                              tp=self.tp),
+                clock=self._clock, chaos=chaos, tp=self.tp,
+                devices=devs, **engine_kw)
             self.replicas.append(Replica(idx=i, engine=eng))
         self._migrants: List[_Migrant] = []
         self._migrated_rids: set = set()
@@ -757,6 +773,18 @@ class ReplicaFleet:
             }
         return {
             "n_replicas": len(self.replicas),
+            # DP×TP geometry: total chips = n_replicas * tp; the
+            # per-shard pool footprint and the per-program collective
+            # budget come from any live engine (all replicas share one
+            # geometry by construction)
+            "tp": self.tp,
+            "total_chips": len(self.replicas) * self.tp,
+            "kv_bytes_per_shard": next(
+                (r.engine.spec_local.cache_bytes()
+                 for r in self.replicas if r.live), None),
+            "psum_per_program": next(
+                (r.engine.program_psum_counts()
+                 for r in self.replicas if r.live), None),
             "n_requests": len(reqs),
             "completed": len(completed),
             "by_status": by_status,

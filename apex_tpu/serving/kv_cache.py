@@ -144,6 +144,31 @@ class PagedKVSpec:
         """Pages needed to hold ``n_tokens``."""
         return -(-int(n_tokens) // self.page_size)
 
+    def shard(self, tp: int) -> "PagedKVSpec":
+        """The per-shard spec of a head-sharded pool: ``num_heads / tp``
+        heads, everything else unchanged.
+
+        The returned spec's own constructor re-validates that the LOCAL
+        page (``heads/tp * page * dim`` elems) is still ROW-aligned — a
+        TP engine must pick ``page_size`` from the local head count
+        (``default_page_size(num_heads // tp, head_dim)``), or this
+        raises at construction rather than mis-packing at runtime. Each
+        shard's ``pack_spec`` is one chunk-aligned PackSpec over its
+        local pool slice; ``check_pack_spec(global.pack_spec,
+        shard_count=tp)`` is the matching whole-pool gate.
+        """
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if self.num_heads % tp:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by tp={tp}")
+        if tp == 1:
+            return self
+        return PagedKVSpec(
+            self.num_layers, self.num_heads // tp, self.head_dim,
+            page_size=self.page_size, num_pages=self.num_pages,
+            pages_per_seq=self.pages_per_seq, dtype=self.dtype)
+
     # -- device state ------------------------------------------------------
     def init_cache(self) -> KVCacheState:
         return KVCacheState(pages=jnp.zeros(

@@ -86,6 +86,7 @@ class RejectionCode(enum.Enum):
     SHED = "shed"                              # degradation shed
     ALREADY_IN_FLIGHT = "already_in_flight"    # duplicate submission
     NO_FEASIBLE_REPLICA = "no_feasible_replica"  # fleet router: none fit
+    UNSUPPORTED_SAMPLING = "unsupported_sampling"  # TP: top_k beyond filter
 
 
 @dataclass(frozen=True)

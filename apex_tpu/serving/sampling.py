@@ -240,3 +240,115 @@ def sample_tokens(logits: jax.Array, temps: jax.Array,
     sampled = jax.lax.cond(jnp.any(temps > 0.0), draw,
                            lambda _: greedy_tok, operand=None)
     return jnp.where(temps <= 0.0, greedy_tok, sampled)
+
+
+def sample_tokens_tp(logits: jax.Array, temps: jax.Array,
+                     top_ks: jax.Array, top_ps: jax.Array,
+                     seeds: jax.Array, rids: jax.Array,
+                     positions: jax.Array, *, axis_name: str,
+                     vocab_size: int):
+    """Vocab-parallel :func:`sample_tokens`: each shard holds the
+    ``[R, V/tp]`` logits slice for global ids ``[s*V/tp, (s+1)*V/tp)``
+    and the full vocab is never materialized on one chip.
+
+    Returns ``(tokens [R] int32, nonfinite [R] bool)`` — the poison
+    flag rides the sampler's one fused ``psum`` instead of needing a
+    second reduction. Token-identity with the replicated sampler:
+
+    - **candidates**: each shard's ``lax.top_k(·, 64)`` of its RAW
+      slice is all-gathered shard-major (``[R, tp*64]`` values + global
+      ids — the only gathered tensors, never ``[R, V]``). The global
+      argmax lives in every shard's top-1, and shard-major concat
+      preserves ascending-global-id tie order, so greedy decode
+      reproduces ``argmax``'s lowest-id tie rule bitwise.
+    - **thresholds**: the global top-64 DESCENDING prefix of the scaled
+      candidates equals TP=1's ``lax.top_k(scaled, 64)[0]`` (scaling is
+      monotone, per-element bitwise identical), so the
+      :func:`_thresholds` math runs unchanged on it. The one quantity
+      that genuinely spans the vocab — the softmax denominator — is a
+      ``psum`` of per-shard partials (fused with the nonfinite count).
+    - **draw**: Gumbel noise is keyed by GLOBAL vocab id, so each
+      shard's ``[R, V/tp]`` slice of ``allowed + gumbel`` is bitwise
+      TP=1's; the winner combines via ``pmax`` + lowest-id ``pmin``,
+      matching ``argmax`` semantics exactly.
+
+    Honesty notes (docs/serving.md): the deep-threshold full-sort
+    fallback is TP=1-only — a TP engine must refuse ``top_k >
+    TOP_FILTER_WIDTH`` at submit. Thresholds come from the full
+    ``tp * 64``-deep gathered prefix, which IS the full sort whenever
+    ``tp * 64 >= vocab`` (every test model); on a larger vocab a row
+    whose top-``tp * 64`` mass misses ``top_p`` keeps the prefix
+    threshold (real configs never get there). The denominator's psum
+    bracketing can differ from TP=1's single-axis sum in the last ulp;
+    a token flip would need a row's top-p boundary to land exactly on
+    that ulp.
+    """
+    logits = logits.astype(jnp.float32)
+    R, Vl = logits.shape
+    V = int(vocab_size)
+    shard = jax.lax.axis_index(axis_name)
+    base = (shard * Vl).astype(jnp.int32)
+
+    # per-shard candidates: raw top-W of the local slice, global ids
+    Wl = min(TOP_FILTER_WIDTH, Vl)
+    lvals, lidx = jax.lax.top_k(logits, Wl)
+    gvals = jax.lax.all_gather(lvals, axis_name, axis=1, tiled=True)
+    ggids = jax.lax.all_gather(lidx.astype(jnp.int32) + base,
+                               axis_name, axis=1, tiled=True)
+    greedy_tok = jnp.take_along_axis(
+        ggids, jnp.argmax(gvals, axis=-1)[:, None], axis=1)[:, 0]
+
+    # thresholds from the FULL gathered candidate prefix (tp * 64 deep,
+    # not clamped to 64): a descending prefix's threshold math is
+    # prefix-invariant (see :func:`_thresholds`), so covered rows get
+    # the replicated prefix path's bits, and a row whose top-64 mass
+    # misses ``top_p`` gets the DEEP path's bits whenever ``tp * 64 >=
+    # V`` (the full gather IS the full sort then — the tiny-vocab test
+    # models live here). DIVIDE by the temperature exactly as the
+    # replicated sampler does: ``x / t`` and ``x * (1/t)`` differ in
+    # the last ulp, and the identity contract is bitwise.
+    t = jnp.maximum(temps, 1e-6)
+    scaled = logits / t[:, None]
+    W = int(gvals.shape[1])
+    vals_desc = jax.lax.top_k(gvals / t[:, None], W)[0]
+    k_idx = jnp.clip(top_ks, 1, W).astype(jnp.int32) - 1
+    kth = jnp.take_along_axis(vals_desc, k_idx[:, None], axis=1)[:, 0]
+    k_all = (top_ks <= 0) | (top_ks >= V)
+    kth = jnp.where(k_all, -jnp.inf, kth)
+    m = vals_desc[:, 0]
+
+    # the sampler's ONE psum: softmax denominator partials over the
+    # sharded vocab, fused with the nonfinite count (poison flag)
+    part = jnp.sum(jnp.where(scaled >= kth[:, None],
+                             jnp.exp(scaled - m[:, None]), 0.0), axis=-1)
+    nonfin_l = jnp.sum((~jnp.isfinite(logits)).astype(jnp.float32),
+                       axis=-1)
+    tot = jax.lax.psum(jnp.stack([part, nonfin_l], axis=-1), axis_name)
+    denom, nonfin_ct = tot[..., 0], tot[..., 1]
+
+    ms = jnp.where(vals_desc >= kth[:, None], vals_desc, -jnp.inf)
+    probs = jnp.exp(ms - m[:, None]) / denom[:, None]
+    cum = jnp.cumsum(probs, axis=-1)
+    n_keep = jnp.sum((cum - probs) < top_ps[:, None],
+                     axis=-1).astype(jnp.int32)
+    thresh = jnp.take_along_axis(
+        ms, jnp.maximum(n_keep - 1, 0)[:, None], axis=1)[:, 0]
+    thresh = jnp.where(top_ps >= 1.0, -jnp.inf, thresh)
+
+    # distributed Gumbel-max over the local slice, keyed by global id
+    keep = (scaled >= kth[:, None]) & (scaled >= thresh[:, None])
+    allowed = jnp.where(keep, scaled, -jnp.inf)
+    vi = jax.lax.broadcasted_iota(jnp.int32, (R, Vl), 1) + base
+    u = uniform_from_hash(seeds[:, None], rids[:, None],
+                          positions[:, None], vi)
+    y = allowed + (-jnp.log(-jnp.log(u)))
+    lbest = jnp.max(y, axis=-1)
+    larg = jnp.argmax(y, axis=-1).astype(jnp.int32) + base
+    wbest = jax.lax.pmax(lbest, axis_name)
+    warg = jax.lax.pmin(
+        jnp.where(lbest == wbest, larg, jnp.int32(jnp.iinfo(jnp.int32).max)),
+        axis_name)
+    sampled = jnp.minimum(warg, V - 1)  # all-NaN rows are poisoned anyway
+
+    tok = jnp.where(temps <= 0.0, greedy_tok, sampled).astype(jnp.int32)
+    return tok, nonfin_ct > 0.0

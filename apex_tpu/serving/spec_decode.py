@@ -55,9 +55,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..transformer import parallel_state
 from .decode_model import chunk_hidden, lm_logits
 from .kv_cache import KVCacheState, PagedKVSpec
-from .sampling import sample_tokens
+from .sampling import sample_tokens, sample_tokens_tp
 
 Pytree = object
 
@@ -128,6 +129,7 @@ def run_spec_step(
     prefill_chunk: int,
     use_kernel: Optional[bool] = None,
     interpret: bool = False,
+    tp_axis: Optional[str] = None,
 ):
     """One unified draft→verify→accept step over every slot.
 
@@ -201,10 +203,11 @@ def run_spec_step(
     hist = hist.at[jnp.arange(B)[:, None], destc].set(tok)
 
     # 5. ONE chunk-shaped target pass verifies all C positions
+    # (vocab-parallel under tp_axis: logits are [B, C, V/tp])
     h, pages = chunk_hidden(cfg, params, spec, kv, tok, pclamp, valid,
                             page_tables, use_kernel=use_kernel,
-                            interpret=interpret)
-    logits = lm_logits(cfg, params, h)                   # [B, C, V]
+                            interpret=interpret, tp_axis=tp_axis)
+    logits = lm_logits(cfg, params, h, tp_axis=tp_axis)
     logits = jnp.where(poison[:, None, None], jnp.float32(jnp.nan),
                        logits)
 
@@ -215,11 +218,22 @@ def run_spec_step(
     def rep(a):
         return jnp.broadcast_to(a[:, None], (B, C)).reshape(B * C)
 
-    e = sample_tokens(
-        logits.reshape(B * C, V),
-        rep(slots.temps), rep(slots.top_ks), rep(slots.top_ps),
-        rep(slots.seeds), rep(slots.rids),
-        (pclamp + 1).reshape(B * C)).reshape(B, C)
+    if tp_axis is None:
+        e = sample_tokens(
+            logits.reshape(B * C, V),
+            rep(slots.temps), rep(slots.top_ks), rep(slots.top_ps),
+            rep(slots.seeds), rep(slots.rids),
+            (pclamp + 1).reshape(B * C)).reshape(B, C)
+        nonfin = ~jnp.all(jnp.isfinite(logits), axis=-1)  # [B, C]
+    else:
+        e_flat, nf = sample_tokens_tp(
+            logits.reshape(B * C, V),
+            rep(slots.temps), rep(slots.top_ks), rep(slots.top_ps),
+            rep(slots.seeds), rep(slots.rids),
+            (pclamp + 1).reshape(B * C), axis_name=tp_axis,
+            vocab_size=V * parallel_state.axis_size(tp_axis))
+        e = e_flat.reshape(B, C)
+        nonfin = nf.reshape(B, C)
 
     # 7. accept: draft j survives iff it equals position pos+j's own
     # carried draw AND every earlier draft survived
@@ -237,7 +251,8 @@ def run_spec_step(
     # sequential decode would never have performed, and its garbage is
     # rolled back with the draft; quarantining on it would FAIL a
     # request plain decode completes, breaking the lossless contract.
-    nonfin = ~jnp.all(jnp.isfinite(logits), axis=-1)     # [B, C]
+    # (``nonfin`` [B, C] computed above — locally for the replicated
+    # engine, via the TP sampler's fused psum under tp_axis)
     emit_cols = jnp.where(prefilling[:, None], valid,
                           cols[None, :] < n_emit_dec[:, None])
     bad = active & jnp.any(emit_cols & nonfin, axis=1)

@@ -131,6 +131,63 @@ def get_mesh() -> Mesh:
     return _MESH
 
 
+def tp_submesh(tp: int, *, replica: int = 0, devices=None) -> Mesh:
+    """A single-axis ``(TENSOR_AXIS,)`` mesh of ``tp`` devices — the
+    per-replica slice a TP serving engine shard_maps over.
+
+    Resolution order mirrors the fleet's DP×TP topology (replica ``i``
+    owns TP group ``i``):
+
+    - explicit ``devices``: use them verbatim (must be exactly ``tp``);
+    - an initialized global mesh: row ``replica`` of its
+      ``(dp, tensor)`` reshape — the engine inherits the training
+      mesh's placement, so weights sharded by ``tensor_parallel``
+      layers land where serving reads them;
+    - otherwise: ``jax.devices()[replica*tp : (replica+1)*tp]``.
+    """
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if devices is not None:
+        devices = list(devices)
+        if len(devices) != tp:
+            raise ValueError(
+                f"got {len(devices)} devices for tp={tp}")
+        return Mesh(np.asarray(devices), (TENSOR_AXIS,))
+    if _MESH is not None:
+        flat = _MESH.devices.reshape(-1)
+        if tp * (replica + 1) > flat.size:
+            raise ValueError(
+                f"replica {replica} x tp={tp} exceeds the initialized "
+                f"mesh ({flat.size} devices)")
+        if _TENSOR_MODEL_PARALLEL_WORLD_SIZE not in (None, 1, tp):
+            raise ValueError(
+                f"engine tp={tp} disagrees with the initialized mesh's "
+                f"tensor axis ({_TENSOR_MODEL_PARALLEL_WORLD_SIZE})")
+        group = flat[replica * tp:(replica + 1) * tp]
+        return Mesh(group, (TENSOR_AXIS,))
+    devs = jax.devices()
+    if tp * (replica + 1) > len(devs):
+        raise ValueError(
+            f"replica {replica} x tp={tp} needs device "
+            f"{tp * (replica + 1) - 1} but only {len(devs)} exist")
+    return Mesh(np.asarray(devs[replica * tp:(replica + 1) * tp]),
+                (TENSOR_AXIS,))
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis, from inside a traced program.
+
+    ``jax.lax.axis_size`` where it exists; on older jax (this tree's
+    0.4.x floor) ``jax.core.axis_frame`` already returns the bound
+    axis size. The pipeline/context-parallel modules skip their tests
+    when ``lax.axis_size`` is missing — the serving TP path must not,
+    so it routes through this shim.
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
 def _axis_index_or_raise(axis: str, what: str):
     """Traced axis index inside shard_map; 0 if the axis has size 1."""
     sizes = {

@@ -1036,6 +1036,119 @@ def bench_serving_fleet():
     }}
 
 
+def bench_serving_tp():
+    """``serving_tp`` leg: the equal-chip DP-vs-TP A/B (ISSUE-16).
+
+    The same staggered request trace served twice on the same chip
+    budget (``BENCH_TP``, default 2, chips): once as a pure-DP fleet of
+    ``tp`` single-chip replicas, once as ONE tensor-parallel engine
+    shard_mapped over the ``tp``-device named mesh (head-sharded paged
+    KV pool, column/row-parallel GEMMs, 3 psums per program). Headline
+    numbers are the TP arm's — ``tokens_per_sec`` and request
+    ``p99_ms`` are what ``compare_bench`` tracks — with the DP arm's
+    beside them for the trade: DP wins aggregate throughput on small
+    models (two independent batches, no collectives), TP wins per-
+    request latency and per-chip KV headroom (each chip holds 1/tp of
+    the pool, so a model/context that cannot fit one chip serves at
+    all). Also reported: the per-chip KV bytes of both arms and the
+    TP engine's pinned psum-per-program counts.
+    """
+    import numpy as _np
+
+    from apex_tpu.serving import ReplicaFleet, Request
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    tp = int(os.environ.get("BENCH_TP", "2"))
+    if len(jax.devices()) < tp:
+        raise RuntimeError(
+            f"serving_tp leg needs >= {tp} devices "
+            f"(have {len(jax.devices())}); on CPU smoke runs set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    n_req = int(os.environ.get("BENCH_TP_REQUESTS", os.environ.get(
+        "BENCH_SERVING_REQUESTS", "16")))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+    def mk_trace():
+        rng = _np.random.default_rng(16)
+        return [
+            Request(
+                prompt=[int(t) for t in
+                        rng.integers(0, cfg.vocab_size, size=prompt_len)],
+                max_new_tokens=max_new,
+                arrival_step=int(i * max(1, max_new // 2)
+                                 // max(1, n_slots)))
+            for i in range(n_req)
+        ]
+
+    def run(n_replicas, arm_tp):
+        fleet = ReplicaFleet(
+            cfg, params, n_replicas=n_replicas, tp=arm_tp,
+            sink=telemetry_recorder(), n_slots=n_slots,
+            prefill_chunk=chunk, telemetry_every=8)
+        fleet.generate(mk_trace(),
+                       max_steps=(prompt_len + max_new) * n_req + 2000)
+        fleet.check_invariants()
+        eng = fleet.replicas[0].engine
+        st = fleet.last_stats
+        lat = st["latency_ms"]
+        return {
+            "tokens_per_sec": st["tokens_per_sec"],
+            "p50_ms": lat.get("p50"),
+            "p99_ms": lat.get("p99"),
+            "ttft_p99_ms": st["ttft_ms"].get("p99"),
+            "kv_bytes_per_chip": eng.spec_local.cache_bytes(),
+            "psum_per_program": eng.program_psum_counts(),
+            "steps": st["steps"],
+            "page_leaks": fleet.page_leaks(),
+        }
+
+    tp_arm = run(1, tp)
+    dp_arm = run(tp, 1)
+    return {"serving_tp": {
+        "tp": tp,
+        "chips": tp,
+        # headline (compare_bench-gated): the tensor-parallel engine
+        "tokens_per_sec": tp_arm["tokens_per_sec"],
+        "p50_ms": tp_arm["p50_ms"],
+        "p99_ms": tp_arm["p99_ms"],
+        "ttft_p99_ms": tp_arm["ttft_p99_ms"],
+        "kv_bytes_per_chip": tp_arm["kv_bytes_per_chip"],
+        "psum_per_program": tp_arm["psum_per_program"],
+        "steps": tp_arm["steps"],
+        "page_leaks": tp_arm["page_leaks"] + dp_arm["page_leaks"],
+        # the equal-chip DP reference arm
+        "dp_tokens_per_sec": dp_arm["tokens_per_sec"],
+        "dp_p50_ms": dp_arm["p50_ms"],
+        "dp_p99_ms": dp_arm["p99_ms"],
+        "dp_kv_bytes_per_chip": dp_arm["kv_bytes_per_chip"],
+        "tp_vs_dp_throughput": (
+            round(tp_arm["tokens_per_sec"] / dp_arm["tokens_per_sec"], 4)
+            if dp_arm["tokens_per_sec"] else None),
+        "kv_bytes_per_chip_ratio": (
+            round(tp_arm["kv_bytes_per_chip"]
+                  / dp_arm["kv_bytes_per_chip"], 4)
+            if dp_arm["kv_bytes_per_chip"] else None),
+        "n_requests": n_req,
+        "slots": n_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "layers": layers,
+        "prefill_chunk": chunk,
+    }}
+
+
 def bench_prefix_reuse():
     """``prefix_reuse`` leg: the amortize-the-fleet's-shared-context
     measurement (ISSUE-12) — a Zipfian shared-prefix trace (a FEW
@@ -2125,6 +2238,23 @@ def main() -> None:
             print(f"serving fleet bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # tensor-parallel leg: the equal-chip DP-vs-TP A/B — the TP arm's
+    # tokens/sec + p99 latency (compare_bench-gated) against the pure-
+    # DP fleet on the same chips, plus per-chip KV bytes and the pinned
+    # psum-per-program counts (ISSUE-16). Gated like the serving legs
+    # (BENCH_SERVING_TP overrides); needs >= BENCH_TP devices.
+    serving_tp = None
+    want_tp = os.environ.get("BENCH_SERVING_TP", want_serving)
+    if want_tp != "0" and (not fast or want_tp == "1"):
+        try:
+            serving_tp = _retry_transient(
+                bench_serving_tp, tag="serving tp leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"serving tp bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     # prefix-reuse leg: the Zipfian shared-prefix trace measuring what
     # the radix/hash prefix cache + chunked prefill buy — warm-vs-cold
     # TTFT, hit rate, prefill flops saved (ISSUE-12). Gated like the
@@ -2264,6 +2394,7 @@ def main() -> None:
         "prefill_decode_split": (serving or {}).get("prefill_decode_split"),
         "serving_overload": (serving_overload or {}).get("serving_overload"),
         "serving_fleet": (serving_fleet or {}).get("serving_fleet"),
+        "serving_tp": (serving_tp or {}).get("serving_tp"),
         "prefix_reuse": (prefix_reuse or {}).get("prefix_reuse"),
         "spec_decode": (spec_decode or {}).get("spec_decode"),
         "grad_lifecycle": (grad_lifecycle or {}).get("grad_lifecycle"),

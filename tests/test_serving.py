@@ -15,7 +15,14 @@ Coverage map (the ISSUE-6 acceptance surface):
 - assert_step_clean on the jitted decode step (KV cache donated, no
   ungated callbacks) with the in-jit telemetry drain ARMED;
 - satellites: amp.cast_params_for_inference, telemetry.percentiles,
-  tools/serving_check.py exit codes, compare_bench serving legs.
+  tools/serving_check.py exit codes, compare_bench serving legs;
+- tensor parallelism (ISSUE-16): TP=2/4 token identity vs TP=1 on the
+  8-virtual-device mesh (tools/serving_check tp_identity), the 3-psum-
+  per-program jaxpr pin with no pool-shaped all-gather, head-sharded
+  PagedKVSpec geometry, sharding-preserving inference cast, the
+  top_k<=filter-width submit guard, TP-tagged telemetry + DP x TP fleet
+  summary, topology-preserving recover/rebuild/swap, and the committed
+  equal-chip DP-vs-TP bench artifact.
 """
 import json
 
@@ -653,3 +660,230 @@ def test_scheduler_rejects_request_pool_can_never_hold(tiny_model):
     for r in reqs:
         assert out[r.rid] == reference_decode(
             cfg, params, r.prompt, r.max_new_tokens)
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism (ISSUE-16): TP-sharded engine over the named mesh
+# ---------------------------------------------------------------------------
+
+def test_tp_identity_sweep():
+    """The ISSUE-16 oracle, wired tier-1: ``tools/serving_check.py``'s
+    ``tp_identity`` leg — TP=2 and TP=4 engines on the 8-virtual-device
+    mesh are byte-identical to TP=1 across a staggered trace with
+    chunked prefill, speculation, sampled + greedy slots and forced
+    preemption, and every TP program's jaxpr carries exactly 3 psums."""
+    import tools.serving_check as sc
+
+    res = sc.check_tp_identity()
+    assert res["tps"] == [2, 4], res
+    assert res["ok"], res
+
+
+def test_tp_spec_shard_and_page_size(tiny_model):
+    """Geometry: the per-shard spec holds heads/tp of every page as one
+    ROW-aligned PackSpec (check_pack_spec clean at shard_count=tp), and
+    the default page size derives from the LOCAL head count."""
+    from apex_tpu.analysis.rules import check_pack_spec
+
+    cfg, params = tiny_model
+    e1 = ServingEngine(cfg, params, n_slots=2, use_kernel=False)
+    e2 = ServingEngine(cfg, params, n_slots=2, tp=2, use_kernel=False)
+    assert e2.spec_local.num_heads == e2.spec.num_heads // 2
+    assert e2.spec_local.page_size == e2.spec.page_size
+    # per-shard K/V page still ROW-aligned -> larger default page than
+    # the unsharded engine needs (4 heads/16 dim: 16 -> 32 tokens)
+    assert e2.spec.page_size > e1.spec.page_size
+    assert not check_pack_spec(e2.spec.pack_spec, shard_count=2)
+    assert e2.spec_local.cache_bytes() * 2 == e2.spec.cache_bytes()
+    # indivisible head counts / vocab are construction errors
+    with pytest.raises(ValueError, match="not divisible"):
+        ServingEngine(cfg, params, n_slots=2, tp=3, use_kernel=False)
+
+
+def test_tp_psum_pin_and_no_pool_gather(tiny_model):
+    """The collective budget, pinned on the traced programs: exactly
+    one psum per transformer sublayer tail plus ONE fused sampler
+    reduction = 3 per program (the fori_loop body appears once in the
+    jaxpr) — and no all-gather ever touches a pool-shaped array (the
+    only gathered operands are tiny sampler candidate matrices)."""
+    import math
+    import re
+
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, n_slots=2, tp=2, use_kernel=False,
+                        prefill_chunk=3, spec_k=2)
+    counts = eng.program_psum_counts()
+    assert counts == {"decode": 3, "chunk_prefill": 3, "spec_verify": 3}
+    pool_elems = math.prod(eng.spec_local.pool_leaf_shape)
+    for fn, args in (eng.step_program(), eng.chunk_step_program(),
+                     eng.spec_step_program()):
+        txt = str(jax.make_jaxpr(fn)(*args))
+        gathered = [m for m in txt.splitlines() if "all_gather" in m]
+        assert gathered  # the sampler's candidate gather is there
+        # an all-gather's output is >= its operand: bounding every
+        # gathered RESULT far below one pool leaf proves no KV page
+        # (page_size x head_dim trailing dims) ever crossed shards
+        for line in gathered:
+            for shp in re.findall(r"\[([\d,]+)\]", line):
+                dims = tuple(int(x) for x in shp.split(","))
+                assert math.prod(dims) < pool_elems // 4, (
+                    f"all-gather of pool-scale operand {dims}: {line}")
+
+
+def test_tp_engine_summary_and_events(tiny_model):
+    """_summarize carries tp / per-shard pool bytes / psum counts, and
+    fleet telemetry events are tagged with the TP degree."""
+    from apex_tpu.serving import ReplicaFleet
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, n_slots=2, tp=2, use_kernel=False)
+    out = eng.generate([Request(prompt=[1, 2, 3], max_new_tokens=4)],
+                       max_steps=200)
+    assert len(out) == 1
+    st = eng.last_stats
+    assert st["tp"] == 2
+    assert st["kv_bytes_per_shard"] == eng.spec_local.cache_bytes()
+    assert st["psum_per_program"] == {"decode": 3}
+    # tp=1 engines report the null collective budget
+    e1 = ServingEngine(cfg, params, n_slots=2, use_kernel=False)
+    e1.generate([Request(prompt=[1, 2, 3], max_new_tokens=2)],
+                max_steps=100)
+    assert e1.last_stats["tp"] == 1
+    assert e1.last_stats["psum_per_program"] is None
+
+    ring = RingBufferRecorder()
+    fleet = ReplicaFleet(cfg, params, n_replicas=2, tp=2, sink=ring,
+                         n_slots=2, use_kernel=False)
+    reqs = [Request(prompt=[2 + i, 3 + i], max_new_tokens=3)
+            for i in range(3)]
+    fleet.generate(reqs, max_steps=300)
+    st = fleet.last_stats
+    assert st["tp"] == 2 and st["total_chips"] == 4
+    assert st["psum_per_program"] == {"decode": 3}
+    tagged = [r for r in ring.records if "tp" in r and "replica_id" in r]
+    assert tagged and all(r["tp"] == 2 for r in tagged)
+    # DP x TP replicas own disjoint device groups
+    groups = [{d.id for d in
+               rep.engine._mesh.devices.reshape(-1)}
+              for rep in fleet.replicas]
+    assert groups[0].isdisjoint(groups[1])
+
+
+def test_tp_audit_covers_sharded_programs(tiny_model):
+    """engine.audit() stays clean on the TP-traced step: KV / slot /
+    metrics donation and the cond-gated telemetry callback survive the
+    shard_map wrapper, with the pool PackSpec checked at shard_count=tp
+    (the in-jit drain ARMED, as in the tp=1 audit)."""
+    from apex_tpu.telemetry import RingBufferRecorder
+
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, n_slots=2, tp=2, use_kernel=False,
+                        telemetry_every=4, prefill_chunk=3, spec_k=2,
+                        sink=RingBufferRecorder())
+    report = eng.audit()
+    assert report.ok
+
+
+def test_tp_rejects_deep_top_k(tiny_model):
+    """The TP sampler has no full-vocab-sort fallback: top_k beyond
+    TOP_FILTER_WIDTH is refused at submit with a typed reason (tp=1
+    keeps accepting it — the lax.cond deep path serves it there)."""
+    from apex_tpu.serving import SamplingParams
+    from apex_tpu.serving.robustness import RejectionCode
+    from apex_tpu.serving.sampling import TOP_FILTER_WIDTH
+
+    cfg, params = tiny_model
+    deep = SamplingParams(temperature=0.9, top_k=TOP_FILTER_WIDTH + 1,
+                          seed=3)
+    eng = ServingEngine(cfg, params, n_slots=2, tp=2, use_kernel=False)
+    reason = eng._engine_reject_reason(
+        Request(prompt=[1, 2], max_new_tokens=2, sampling=deep))
+    assert reason is not None
+    assert reason.code is RejectionCode.UNSUPPORTED_SAMPLING
+    with pytest.raises(SchedulerError, match="filter width"):
+        eng.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                           sampling=deep))
+    e1 = ServingEngine(cfg, params, n_slots=2, use_kernel=False)
+    assert e1._engine_reject_reason(
+        Request(prompt=[1, 2], max_new_tokens=2, sampling=deep)) is None
+
+
+def test_cast_params_for_inference_preserves_sharding(tiny_model):
+    """Satellite 1 (red test): casting a mesh-sharded param tree keeps
+    every leaf's NamedSharding — a TP engine's column/row weight slices
+    must not silently gather onto one device — and an already-cast
+    sharded leaf comes back as the SAME buffer (zero-copy identity)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from apex_tpu.amp import cast_params_for_inference
+    from apex_tpu.transformer import parallel_state
+
+    mesh = parallel_state.tp_submesh(2)
+    col = NamedSharding(mesh, PartitionSpec("tensor", None))
+    rep = NamedSharding(mesh, PartitionSpec())
+    params = {
+        "w_col": jax.device_put(
+            jnp.asarray(np.arange(32.0).reshape(8, 4), jnp.float32), col),
+        "b_rep": jax.device_put(jnp.ones((4,), jnp.float32), rep),
+        "ids": jnp.arange(4, dtype=jnp.int32),
+    }
+    out = cast_params_for_inference(params, jnp.bfloat16)
+    assert out["w_col"].dtype == jnp.bfloat16
+    assert out["w_col"].sharding.is_equivalent_to(col, 2)
+    assert out["b_rep"].sharding.is_equivalent_to(rep, 1)
+    assert out["ids"] is params["ids"]
+    # idempotent re-cast of the sharded tree: same buffers, no copies
+    again = cast_params_for_inference(out, jnp.bfloat16)
+    assert again["w_col"] is out["w_col"]
+    assert again["b_rep"] is out["b_rep"]
+
+
+def test_serving_tp_bench_artifact_and_compare_legs():
+    """Satellite 4: the committed equal-chip DP-vs-TP smoke artifact
+    parses and carries the contract numbers (psum budget, halved
+    per-chip pool, zero leaks), and compare_bench extracts + orients
+    the two gated serving_tp legs."""
+    from tools.compare_bench import extract_legs
+
+    art = json.load(open("bench_artifacts/serving_tp_cpu_smoke.json"))
+    tp = art["serving_tp"]
+    assert tp["tp"] == 2 and tp["chips"] == 2
+    assert tp["tokens_per_sec"] > 0 and tp["dp_tokens_per_sec"] > 0
+    assert all(v == 3 for v in tp["psum_per_program"].values())
+    assert tp["kv_bytes_per_chip_ratio"] == 0.5
+    assert tp["page_leaks"] == 0
+    legs = extract_legs(art)
+    assert legs["serving_tp_tokens_per_sec"] == tp["tokens_per_sec"]
+    # lower-is-better legs are sign-inverted at extraction
+    assert legs["serving_tp_p99_ms"] == -tp["p99_ms"]
+
+
+def test_tp_recover_and_swap_keep_topology(tiny_model):
+    """recover_from / rebuild_like / swap_params preserve the TP
+    geometry (captured ctor kwargs): the revived engine decodes
+    token-identically on the same device group, and a weight swap lays
+    the fresh tree down SHARDED before the cast."""
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, n_slots=2, tp=2, use_kernel=False)
+    reqs = [Request(prompt=[3, 4, 5, 6], max_new_tokens=5)]
+    ref = reference_decode(cfg, params, [3, 4, 5, 6], 5)
+    out = eng.generate(list(reqs), max_steps=200)
+    assert out[reqs[0].rid] == ref
+
+    fresh = ServingEngine.rebuild_like(eng)
+    assert fresh.tp == 2 and fresh._mesh is not None
+    r2 = Request(prompt=[3, 4, 5, 6], max_new_tokens=5)
+    assert fresh.generate([r2], max_steps=200)[r2.rid] == ref
+
+    # hot swap: sharded placement preserved, decode follows new weights
+    params2 = jax.tree_util.tree_map(lambda x: x, params)
+    params2["embedding"]["position"] = (
+        params["embedding"]["position"] * 0.5)
+    fresh.swap_params(params2)
+    qkv = fresh.params["layers"]["qkv_w"]
+    assert "tensor" in str(qkv.sharding.spec)
+    r3 = Request(prompt=[3, 4, 5, 6], max_new_tokens=5)
+    assert (fresh.generate([r3], max_steps=200)[r3.rid]
+            == reference_decode(cfg, params2, [3, 4, 5, 6], 5))

@@ -59,6 +59,11 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("fleet_goodput", "serving_fleet.goodput_tokens_per_sec", True),
     ("fleet_requests_lost", "serving_fleet.requests_lost", False),
     ("fleet_ttft_p99_ms", "serving_fleet.ttft_p99_ms", False),
+    # ISSUE-16 tensor-parallel serving: the TP arm of the equal-chip
+    # DP-vs-TP A/B — aggregate decode throughput and p99 request
+    # latency of the shard_mapped engine must not regress
+    ("serving_tp_tokens_per_sec", "serving_tp.tokens_per_sec", True),
+    ("serving_tp_p99_ms", "serving_tp.p99_ms", False),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
     ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
     # ISSUE-14 flat-buffer gradient lifecycle A/B: the flat leg must stay

@@ -84,6 +84,17 @@ must hold the zero-loss contract under replica outages):
                           zero dropped requests, and post-update
                           traffic decodes per the NEW weights.
 
+Tensor-parallel leg (ISSUE-16 — the identity oracle over the TP
+sharding):
+
+- ``tp_identity``     ``ServingEngine(tp=2/4)`` on the virtual-device
+                      CPU mesh is byte-identical to the tp=1 engine
+                      across a staggered trace with chunked prefill,
+                      speculation, mixed sampled/greedy slots and
+                      forced preemption — and each TP program's jaxpr
+                      carries exactly 3 psums (2 sublayer tails + 1
+                      fused sampler reduction).
+
 Usage::
 
     python tools/serving_check.py --self           # table, exit 1 on fail
@@ -650,8 +661,79 @@ def check_fleet_drain_join() -> dict:
             "mismatches": mismatches, "page_leaks": fleet.page_leaks()}
 
 
+def check_tp_identity() -> dict:
+    """The tensor-parallel oracle (ISSUE-16): a ``ServingEngine(tp=N)``
+    on the virtual-device CPU mesh emits EXACTLY the tp=1 engine's
+    tokens — across a staggered continuous-batching trace with chunked
+    prefill, speculative decoding, mixed sampled/greedy slots (incl. a
+    no-filter high-temperature row: the full-vocab distributed Gumbel
+    draw) and forced preemption (tiny pool). Byte-identity, not
+    tolerance: head-sharded attention and column/row GEMM shards
+    compute bitwise the same values, and the vocab-parallel sampler's
+    candidate gather reproduces the replicated filter exactly. Skipped
+    (vacuous pass) when the host exposes only 1 device."""
+    import jax
+    import numpy as np
+
+    from apex_tpu.serving import Request, SamplingParams, ServingEngine
+
+    n_dev = len(jax.devices())
+    tps = [t for t in (2, 4) if t <= n_dev]
+    if not tps:
+        return {"ok": True, "skipped": "single-device host", "tps": []}
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+
+    def mk():
+        rng = np.random.default_rng(19)
+        sps = [None,
+               SamplingParams(temperature=0.9, top_k=12, top_p=0.9,
+                              seed=17),
+               SamplingParams(temperature=1.4, seed=23),  # no filters
+               None,
+               SamplingParams(temperature=0.8, top_p=0.8, seed=29)]
+        return [Request(prompt=list(rng.integers(0, cfg.vocab_size,
+                                                 size=L)),
+                        max_new_tokens=8, arrival_step=2 * i,
+                        sampling=sp, rid=47_000 + i)
+                for i, (L, sp) in enumerate(zip((14, 11, 13, 9, 12),
+                                                sps))]
+
+    def run(tp):
+        # tiny pool -> shared slots / possible preemption; chunked
+        # prefill + speculation arm all three jitted programs
+        eng = ServingEngine(cfg, params, n_slots=2, num_pages=6,
+                            max_prompt_len=16, prefill_chunk=3,
+                            spec_k=2, tp=tp)
+        out = eng.generate(mk(), max_steps=2000)
+        eng.scheduler.check_invariants()
+        leaks = eng.scheduler.allocator.used_count
+        return out, eng.last_stats, leaks
+
+    base, base_stats, base_leaks = run(1)
+    mismatches = []
+    psums = {}
+    for tp in tps:
+        out, stats, leaks = run(tp)
+        psums[tp] = stats["psum_per_program"]
+        for rid in base:
+            if out.get(rid) != base[rid]:
+                mismatches.append({"tp": tp, "rid": rid,
+                                   "tp_engine": out.get(rid),
+                                   "tp1": base[rid]})
+        if leaks:
+            mismatches.append({"tp": tp, "page_leaks": leaks})
+    # the collective budget: 2 sublayer tails + 1 fused sampler psum
+    psum_ok = all(all(v == 3 for v in p.values()) for p in psums.values())
+    ok = not mismatches and psum_ok and base_leaks == 0
+    return {"ok": ok, "tps": tps, "mismatches": mismatches,
+            "psum_per_program": psums, "psum_budget_ok": psum_ok}
+
+
 CHECKS = {
     "decode_parity": check_decode_parity,
+    "tp_identity": check_tp_identity,
     "chunked_prefill_identity": check_chunked_prefill_identity,
     "prefix_hit_identity": check_prefix_hit_identity,
     "spec_greedy_identity": check_spec_greedy_identity,

@@ -16,6 +16,12 @@ this tool self-hosts it on the steps the performance story depends on:
                        shard_map GPT step with GradBuckets psum-per-
                        bucket, flat amp unscale + found_inf, and the
                        packed FusedAdam fed the reduced buffer directly;
+- ``tp_step``          the tensor-parallel serving decode step: a
+                       ``ServingEngine(tp=2)`` program shard_mapped
+                       over the ``(tensor,)`` submesh (head-sharded
+                       paged pool, Megatron GEMM sharding,
+                       vocab-parallel sampler), donation and callback
+                       gating intact through the wrapper;
 - ``telemetry_drain``  the in-jit metrics accumulate + cond-gated async
                        drain path.
 
@@ -239,6 +245,38 @@ def build_ddp_step():
     return step, (params, opt_state, sstate), {}
 
 
+def build_tp_step():
+    """The tensor-parallel serving decode step (ISSUE-16): a
+    ``ServingEngine(tp=N)`` 1-token program — shard_mapped over the
+    ``(tensor,)`` submesh with the head-sharded paged pool, Megatron
+    column/row GEMM sharding and the vocab-parallel sampler. tp=2 when
+    the host exposes >= 2 devices (the pytest harness forces 8 virtual
+    CPU devices), else the tp=1 program (identical code path, no
+    collectives). Gated invariants: KV/slot/metrics still donated
+    through the shard_map wrapper, telemetry callback still cond-gated
+    (and OUTSIDE the shard_map), pool PackSpec chunk-aligned per
+    shard."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.serving import ServingEngine
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    tp = 2 if len(jax.devices()) >= 2 else 1
+    cfg = GPTConfig(
+        num_layers=2, num_attention_heads=4, hidden_size=64,
+        vocab_size=128, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.float32,
+    )
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, n_slots=2, tp=tp,
+                        use_kernel=False, telemetry_every=4)
+    fn, args = eng.step_program()
+    return fn, args, {"pack_specs": [eng.spec.pack_spec],
+                      "shard_count": eng.tp}
+
+
 def build_telemetry_drain():
     """The sync-free metrics path: on-device accumulate + the async
     drain that must stay behind lax.cond (telemetry/metrics.py)."""
@@ -264,6 +302,7 @@ TARGETS = {
     "packed_adam_step": build_packed_adam_step,
     "packed_lamb_step": build_packed_lamb_step,
     "ddp_step": build_ddp_step,
+    "tp_step": build_tp_step,
     "telemetry_drain": build_telemetry_drain,
 }
 
