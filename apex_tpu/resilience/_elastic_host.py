@@ -184,7 +184,7 @@ def main() -> int:
 
     sink = None
     if args.events:
-        sink = TaggedRecorder(JsonlRecorder(args.events),
+        sink = TaggedRecorder(JsonlRecorder(args.events), owns_sink=True,
                               tags={"host": args.host, "rank": args.host})
     # the in-host watchdog: hang events from supervised hosts carry the
     # host id/rank (the TaggedRecorder mirror for hang dumps)

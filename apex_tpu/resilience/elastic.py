@@ -84,6 +84,7 @@ from .retry import (
     retry_call,
 )
 from .state import TrainState, device_part, flat_leaves, unflatten_like
+from ..telemetry.spans import Tracer, next_span_id
 
 COMMIT_MARKER = "COMMIT"
 
@@ -283,6 +284,13 @@ class ElasticCheckpointManager(CheckpointManager):
         super().__init__(root, keep_n=keep_n, async_save=async_save,
                          save_every=save_every, sink=sink,
                          watchdog=watchdog, retry=retry, chaos=chaos)
+        # save->stage->barrier->COMMIT as spans, host-tagged through the
+        # same sink the structured events ride (the fake-host harness
+        # wraps it in a TaggedRecorder, so multi-host traces merge).
+        # Span ids carry the host so shards of one ``ckpt-<step>`` trace
+        # from different PROCESSES never collide; timestamps are wall
+        # clock — the only scale fake hosts on one machine share.
+        self.tracer = Tracer(sink=self._record, tags={"host": self.host})
 
     # -- directory bookkeeping (marker-aware) ------------------------------
     def _raw_step_dirs(self) -> List[int]:
@@ -448,6 +456,10 @@ class ElasticCheckpointManager(CheckpointManager):
         # wall-clock start of THIS save attempt: the non-zero ranks'
         # marker-freshness test orders the COMMIT's t_wall against it
         t_save_start = time.time()
+        # one "checkpoint" root per host per attempt, all sharing the
+        # ``ckpt-<step>`` trace; child spans decompose it into the
+        # stage (shard write) and barrier/COMMIT phases
+        root_sid = f"h{self.host}.{next_span_id()}"
         emergency = bool(meta.get("emergency"))
         step_dir = self._step_dir(step)
         # the meta owns the shard identity: a regular save writes THIS
@@ -538,14 +550,35 @@ class ElasticCheckpointManager(CheckpointManager):
                 fsync_dir(step_dir)
                 self._emit({"event": "shard_written", "step": step,
                             "host": self.host, "world": self.world})
+                t_staged = time.time()
+                self.tracer.emit(
+                    "stage", f"ckpt-{step}", t_save_start, t_staged,
+                    span_id=f"h{self.host}.{next_span_id()}",
+                    parent_id=root_sid, step=step, host=self.host,
+                    emergency=emergency, n_leaves=len(snapshot))
                 if chaos is not None:
                     # base hook name, elastic meaning: after this
                     # host's shard landed, before the commit barrier
                     chaos.before_commit(step)
                 self._commit_barrier(step, meta, t_save_start)
-            except BaseException:
+                self.tracer.emit(
+                    "commit_barrier", f"ckpt-{step}", t_staged,
+                    time.time(),
+                    span_id=f"h{self.host}.{next_span_id()}",
+                    parent_id=root_sid, step=step, host=self.host,
+                    committer=self.host == 0 or emergency)
+            except BaseException as e:
                 self._emit({"event": "checkpoint_failed", "step": step,
                             "host": self.host, "tmp": part_tmp})
+                self.tracer.emit(
+                    "checkpoint", f"ckpt-{step}", t_save_start,
+                    time.time(), span_id=root_sid, terminal=True,
+                    step=step, host=self.host, ok=False,
+                    error=f"{type(e).__name__}: {e}")
+                self.tracer.dump_blackbox(
+                    reason="checkpoint_failed", sink=self.tracer.sink,
+                    step=step, host=self.host,
+                    error=f"{type(e).__name__}: {e}")
                 shutil.rmtree(part_tmp, ignore_errors=True)
                 raise
             if self.host == 0:
@@ -558,6 +591,12 @@ class ElasticCheckpointManager(CheckpointManager):
                     "path": step_dir,
                     "emergency": bool(meta.get("emergency")),
                     "duration_s": round(time.perf_counter() - t0, 4)})
+        self.tracer.emit(
+            "checkpoint", f"ckpt-{step}", t_save_start, time.time(),
+            span_id=root_sid, terminal=True, step=step,
+            host=self.host, world=self.world, ok=True,
+            emergency=bool(meta.get("emergency")),
+            duration_s=round(time.perf_counter() - t0, 4))
 
     def _commit_barrier(self, step: int, meta: dict,
                     t_save_start: float) -> None:
@@ -925,6 +964,12 @@ class Supervisor:
         self.incidents: List[Incident] = []
         self.world_history: List[int] = []
         self.restarts = 0
+        # incident spans (detect -> kill -> relaunch -> restore): the
+        # MTTR decomposition, one ``incident-<n>`` trace per incident,
+        # timestamps on the same ``time.monotonic`` scale the detector
+        # uses. The ring doubles as the supervisor's flight recorder,
+        # dumped on every incident and on world failure.
+        self.tracer = Tracer(sink=self._record, tags={"role": "supervisor"})
 
     # -- events ------------------------------------------------------------
     def _emit(self, rec: dict) -> None:
@@ -936,6 +981,40 @@ class Supervisor:
 
     def heartbeat_path(self, host: int) -> str:
         return os.path.join(self.heartbeat_dir, f"hb-{int(host)}")
+
+    def _emit_incident_spans(self, inc: Incident) -> None:
+        """One ``incident-<n>`` trace per incident: the MTTR
+        (detect -> restored world's first heartbeat) decomposed into
+        kill / relaunch / restore child spans. Emitted when recovery
+        resolves — or, for the final unrecovered incident on the
+        world-failed path, with whatever phases actually happened."""
+        n = self.incidents.index(inc)
+        tid = f"incident-{n}"
+        root = next_span_id()
+        t_kill = getattr(inc, "_t_kill", None)
+        t_relaunch = getattr(inc, "_t_relaunch", None)
+        t_end = inc.t_detect
+        self.tracer.emit("detect", tid, inc.t_detect, inc.t_detect,
+                         parent_id=root, kind=inc.kind, host=inc.host,
+                         detail=inc.detail)
+        if t_kill is not None:
+            self.tracer.emit("kill", tid, inc.t_detect, t_kill,
+                             parent_id=root)
+            t_end = t_kill
+        if t_relaunch is not None and t_kill is not None:
+            self.tracer.emit("relaunch", tid, t_kill, t_relaunch,
+                             parent_id=root)
+            t_end = t_relaunch
+        if inc.recovery_s is not None:
+            t_end = inc.t_detect + inc.recovery_s
+            if t_relaunch is not None:
+                self.tracer.emit("restore", tid, t_relaunch, t_end,
+                                 parent_id=root)
+        self.tracer.emit(
+            "incident", tid, inc.t_detect, t_end, span_id=root,
+            terminal=True, kind=inc.kind, host=inc.host,
+            incarnation=inc.incarnation, detail=inc.detail,
+            mttr_s=inc.recovery_s, recovered=inc.recovery_s is not None)
 
     # -- lifecycle ---------------------------------------------------------
     def _launch_world(self, incarnation: int) -> List[_Host]:
@@ -1009,6 +1088,8 @@ class Supervisor:
         while True:
             self.world_history.append(self.world)
             hosts = self._launch_world(incarnation)
+            if pending_recovery is not None:
+                pending_recovery._t_relaunch = time.monotonic()
             incident = None
             while True:
                 if pending_recovery is not None and any(
@@ -1021,6 +1102,7 @@ class Supervisor:
                     # normal speed (recovery_s stays None for it).
                     pending_recovery.recovery_s = round(
                         time.monotonic() - pending_recovery.t_detect, 3)
+                    self._emit_incident_spans(pending_recovery)
                     pending_recovery = None
                 rcs = [hp.proc.poll() for hp in hosts]
                 if all(rc == 0 for rc in rcs):
@@ -1044,8 +1126,14 @@ class Supervisor:
                         "incarnation": incarnation,
                         "detail": incident.detail})
             self._kill_world(hosts)
+            incident._t_kill = time.monotonic()
+            self.tracer.dump_blackbox(
+                reason=incident.kind, sink=self.tracer.sink,
+                host=incident.host, incarnation=incarnation,
+                detail=incident.detail)
             self.restarts += 1
             if self.restarts > self.max_restarts:
+                self._emit_incident_spans(incident)
                 summary = self.summary(
                     ok=False, wall_s=time.monotonic() - t_start)
                 self._emit({"event": "world_failed", **summary})

@@ -76,6 +76,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .. import telemetry
 from ..amp import cast_params_for_inference
 from ..ops.flash_decode import _kernel_ok, flash_decode_available
+from ..resilience.watchdog import HangError
 from ..transformer import parallel_state
 from .decode_model import (  # noqa: F401
     decode_tokens,
@@ -187,6 +188,7 @@ class ServingEngine:
         spec_ngram: int = 3,
         tp: int = 1,
         devices: Optional[Sequence[Any]] = None,
+        trace: bool = True,
     ):
         # recovery (recover_from) rebuilds an engine with the same
         # geometry/policies; capture the kwargs before unpacking
@@ -199,7 +201,8 @@ class ServingEngine:
             degradation=degradation, watchdog=watchdog,
             step_timeout_s=step_timeout_s, chaos=chaos, clock=clock,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-            spec_k=spec_k, spec_ngram=spec_ngram, tp=tp, devices=devices)
+            spec_k=spec_k, spec_ngram=spec_ngram, tp=tp, devices=devices,
+            trace=trace)
         self.cfg = cfg
         n, d = cfg.num_attention_heads, cfg.kv_channels
         #: tensor-parallel degree. tp > 1 head-shards the paged KV pool
@@ -321,6 +324,15 @@ class ServingEngine:
         self.watchdog = watchdog
         self._step_timeout_s = step_timeout_s
         self._clock = clock if clock is not None else time.perf_counter
+        #: end-to-end tracing (telemetry.spans): span records through
+        #: this engine's sink (a fleet's TaggedRecorder tags them with
+        #: the replica id for free) + the bounded flight-recorder ring
+        #: dumped as a black box on hangs and recovery. Span timestamps
+        #: only reuse clock values the engine already read, so tracing
+        #: adds ZERO clock reads (VirtualClock budgets are denominated
+        #: in reads) and traced runs stay deterministic.
+        self.tracer = (telemetry.Tracer(sink=self.sink, clock=self._clock)
+                       if trace else None)
         self.kv = self._place_kv(self.spec.init_cache())
         self.slots = self._replicated(self._init_slots())
         self.metrics = self._replicated(telemetry.init_metrics())
@@ -839,6 +851,20 @@ class ServingEngine:
         now = self._clock()
         if req.t_arrival is None:
             req.t_arrival = now
+        ctx = None
+        if self.tracer is not None:
+            # trace identity stamped once per lifecycle attempt; a
+            # migrant/resubmit keeps its context (and its attribution
+            # ledger — the user has been waiting the whole time)
+            ctx = self.tracer.begin_request_trace(req)
+            if req.attr is None:
+                telemetry.attr_init(req, now)
+            else:
+                telemetry.attr_account(
+                    req, now,
+                    "migration" if getattr(req, "_migrating", False)
+                    else "queue_wait")
+            req._migrating = False
         ctl = self.admission
         depth = len(self.scheduler.waiting)
         reason = self._engine_reject_reason(req)
@@ -853,6 +879,11 @@ class ServingEngine:
             self.sink.record({"event": "reject", "rid": req.rid,
                               "queue_depth": depth,
                               **reason.as_record()})
+            if ctx is not None:
+                self.tracer.emit("admission", ctx.trace_id, now, now,
+                                 parent_id=ctx.span_id,
+                                 outcome=reason.code.value,
+                                 queue_depth=depth)
             self._finalize(req, RequestStatus.REJECTED,
                            reason.code.value, now=now)
             return reason
@@ -869,6 +900,10 @@ class ServingEngine:
                     "max_new_tokens": cap,
                     "requested_max_new": req.max_new_tokens})
                 req.max_new_tokens = cap
+        if ctx is not None:
+            self.tracer.emit("admission", ctx.trace_id, now, now,
+                             parent_id=ctx.span_id, outcome="queued",
+                             queue_depth=depth)
         req.status = RequestStatus.QUEUED
         self.scheduler.waiting.append(req)
         return None
@@ -943,9 +978,14 @@ class ServingEngine:
 
     # -- lifecycle ---------------------------------------------------------
     def _finalize(self, req: Request, status: RequestStatus, reason: str,
-                  *, now: float, failure: Optional[dict] = None) -> None:
+                  *, now: float, failure: Optional[dict] = None,
+                  term: str = "queue_wait") -> None:
         """One typed terminal state per request + a structured
-        ``request_end`` record through the PR-2 recorder."""
+        ``request_end`` record through the PR-2 recorder — and, under
+        tracing, the trace's single TERMINAL span (the "request" root
+        children parent to), closing the attribution ledger with
+        ``term`` for the final interval (zero-length when ``run_step``
+        already accounted this boundary)."""
         if is_terminal(req.status):  # explicit: must survive python -O
             raise AssertionError(
                 f"request {req.rid} finalized twice "
@@ -966,6 +1006,15 @@ class ServingEngine:
         if failure is not None:
             rec["failure"] = dict(failure)
         self.sink.record(rec)
+        self._emit_terminal_span(req, status, reason, now=now, term=term)
+
+    def _emit_terminal_span(self, req: Request, status: RequestStatus,
+                            reason: str, *, now: float, term: str) -> None:
+        if self.tracer is None:
+            return
+        telemetry.spans.emit_terminal_span(
+            self.tracer, req, status.value, reason, now=now, term=term,
+            slo_ok=self._within_budget(req))
 
     def _enforce_deadlines(self, now: float) -> None:
         """Evict expired work at the scheduling boundary: a request past
@@ -988,7 +1037,10 @@ class ServingEngine:
             if why is not None:
                 sched.evict(i)
                 self._finalize(run.req, RequestStatus.TIMED_OUT, why,
-                               now=now)
+                               now=now,
+                               term=("decode" if not run.prefilling else
+                                     "replay" if run.replay else
+                                     "prefill_compute"))
 
     def _boundary_degradation(self, now: float) -> None:
         """Sustained pressure sheds queued work: deadline-infeasible
@@ -1096,10 +1148,21 @@ class ServingEngine:
 
         if self.watchdog is None:
             return fetch()
-        with self.watchdog.armed("serving_step_host_sync",
-                                 timeout_s=self._step_timeout_s,
-                                 context={"step": step_no}):
-            return fetch()
+        try:
+            with self.watchdog.armed("serving_step_host_sync",
+                                     timeout_s=self._step_timeout_s,
+                                     context={"step": step_no}):
+                return fetch()
+        except HangError as e:
+            # the post-mortem black box: the flight ring (what the
+            # engine was doing) merged with the hang's all-thread
+            # stacks (where it stopped), through the same sink the
+            # hang event landed in
+            if self.tracer is not None:
+                self.tracer.dump_blackbox(
+                    reason="hang", sink=self.sink, stacks=e.stacks,
+                    what=e.what, step=step_no)
+            raise
 
     def run_step(self) -> np.ndarray:
         """One scheduling boundary + one device step; returns the
@@ -1128,12 +1191,34 @@ class ServingEngine:
         admitted = sched.admit()
         self._accum["cached_prompt_tokens"] += sum(
             run.cached_tokens for _, run in admitted)
-        sched.ensure_capacity()
+        if self.tracer is not None:
+            for i, run in admitted:
+                run.t_admit = boundary_t
+                ctx = run.req.trace
+                if ctx is not None:
+                    self.tracer.emit(
+                        "admit", ctx.trace_id, boundary_t, boundary_t,
+                        parent_id=ctx.span_id, slot=i, pos=run.pos,
+                        cached_tokens=run.cached_tokens,
+                        replay=run.replay)
+        preempted = sched.ensure_capacity()
+        if self.tracer is not None:
+            for r in preempted:
+                ctx = r.trace
+                if ctx is not None:
+                    self.tracer.emit(
+                        "preempt", ctx.trace_id, boundary_t, boundary_t,
+                        parent_id=ctx.span_id,
+                        preemptions=r.preemptions)
         # pressure rollbacks recompute tokens already counted as
         # cache-skipped: correct the savings accounting
         self._accum["cached_prompt_tokens"] -= \
             sched.take_rollback_tokens()
         forks = sched.take_forks()
+        if self.tracer is not None and forks:
+            self.tracer.emit("cow_fork", "engine-steps", boundary_t,
+                             boundary_t, step=step_no,
+                             n_copies=len(forks), ring_only=True)
         while forks:
             # apply the pending COW page copies BEFORE this step's K/V
             # writes land (padded to a fixed shape so the copy program
@@ -1187,6 +1272,20 @@ class ServingEngine:
             # feasibility stays meaningful under an injected clock;
             # bench timing (_acct) stays on perf_counter
             self.admission.observe_step(now - boundary_t)
+        if self.tracer is not None:
+            # latency attribution: partition [last accounting -> now]
+            # for every request visible at this boundary, using the
+            # SAME `now` that stamps t_first_token/t_done below — so
+            # the per-term sums equal the measured latencies exactly.
+            # Phase is the slot's state at step START (decode vs
+            # prefill vs replay; a cache-hit admission's first interval
+            # buckets to cached_skip once).
+            for r in sched.waiting:
+                telemetry.attr_account(r, now, "queue_wait")
+            for i, run in served:
+                telemetry.attr_account(
+                    run.req, now,
+                    self._phase_term(run, i in decode_slots))
         # normalize the fetched array: the legacy programs emit one
         # token per slot ([B]); the speculative program emits a token
         # MATRIX plus a drafted-count column ([B, C + 1])
@@ -1233,6 +1332,11 @@ class ServingEngine:
                 # other slots' rows never mixed with its math, so their
                 # tokens are byte-identical to an undisturbed run
                 sched.evict(i)
+                if self.tracer is not None and req.trace is not None:
+                    self.tracer.emit(
+                        "quarantine", req.trace.trace_id, now, now,
+                        parent_id=req.trace.span_id, slot=i,
+                        step=step_no, position=run.pos)
                 self._finalize(
                     req, RequestStatus.FAILED, "nonfinite_logits",
                     now=now,
@@ -1246,6 +1350,19 @@ class ServingEngine:
             for tok in toks:
                 if req.t_first_token is None:
                     req.t_first_token = now
+                    if self.tracer is not None:
+                        # freeze the TTFT attribution at the SAME now
+                        # that stamps the latency — terms sum exactly
+                        telemetry.attr_snapshot_ttft(req)
+                        ctx = req.trace
+                        if ctx is not None:
+                            self.tracer.emit(
+                                "prefill", ctx.trace_id,
+                                run.t_admit if run.t_admit is not None
+                                else now, now,
+                                parent_id=ctx.span_id, slot=i,
+                                cached_tokens=run.cached_tokens,
+                                replay=run.replay)
                 req.out_tokens.append(tok)
                 kept += 1
                 if req.done:
@@ -1271,6 +1388,15 @@ class ServingEngine:
                 if (i in decode_slots and i not in bad_slots
                         and sched.slots[i] is run):
                     sched.rollback_kv(i, run, run.pos)
+        if self.tracer is not None:
+            # flight-recorder heartbeat: one ring-only span per step
+            # (never hits the sink — volume stays off the stream, the
+            # black box still shows what the engine was doing)
+            self.tracer.emit(
+                "engine_step", "engine-steps", boundary_t, now,
+                step=step_no, active=len(served),
+                admitted=len(admitted), preempted=len(preempted),
+                queue_depth=len(sched.waiting), ring_only=True)
         self.steps_run += 1
         self._acct(len(served), len(prefill_slots), len(decode_slots),
                    prefill_tokens, dt,
@@ -1279,6 +1405,21 @@ class ServingEngine:
                                      if i not in bad_slots)),
                    n_accepted=n_accepted)
         return em
+
+    @staticmethod
+    def _phase_term(run, decoding: bool) -> str:
+        """The attribution bucket for one slot's boundary interval.
+        Flips the slot's one-shot ``hit_attributed`` latch: a cache-hit
+        admission's first interval is the skip the cache collapsed the
+        prefill into, and buckets to ``cached_skip`` exactly once."""
+        if decoding:
+            return "decode"
+        if run.replay:
+            return "replay"
+        if run.cached_tokens > 0 and not run.hit_attributed:
+            run.hit_attributed = True
+            return "cached_skip"
+        return "prefill_compute"
 
     def _acct(self, n_active, n_prefill, n_decode, n_prefill_tokens, dt,
               *, n_decode_tokens=None, n_drafted=0, n_accepted=0):
@@ -1518,6 +1659,13 @@ class ServingEngine:
             "tp": self.tp,
             "kv_bytes_per_shard": self.spec_local.cache_bytes(),
             "psum_per_program": self.program_psum_counts(),
+            # latency attribution (telemetry.spans): per-term TTFT/e2e
+            # percentiles, the sum-vs-measured identity's max relative
+            # error, and the dominant-cause tally over SLO violators;
+            # None with tracing off
+            "attribution": telemetry.attribution_summary(
+                reqs, violators=[r for r in reqs
+                                 if not self._within_budget(r)]),
         }
 
     def prefix_cache_run_stats(self) -> Optional[Dict[str, Any]]:
@@ -1612,6 +1760,12 @@ class ServingEngine:
             "rids": [r.rid for r in survivors],
             "dead_steps_run": dead.steps_run,
         })
+        if dead.tracer is not None:
+            # the dead engine's flight ring, replayed into the fresh
+            # engine's sink: the crash's last-moments black box
+            dead.tracer.dump_blackbox(
+                reason="engine_recovery", sink=eng.sink,
+                recovered=len(survivors), dead_steps_run=dead.steps_run)
         return eng, survivors
 
 

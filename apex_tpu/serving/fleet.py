@@ -160,6 +160,7 @@ class ReplicaFleet:
         clock: Optional[Callable[[], float]] = None,
         chaos=None,
         migration_retry=None,
+        trace: bool = True,
         **engine_kw,
     ):
         if n_replicas < 1:
@@ -176,6 +177,15 @@ class ReplicaFleet:
         self.tp = int(tp)
         self.sink = sink if sink is not None else telemetry.NullRecorder()
         self._clock = clock if clock is not None else time.perf_counter
+        #: fleet-side tracing: the router/migration/rolling-update hops
+        #: of every request's span tree (engines emit their own spans
+        #: through the same shared sink, replica-tagged). Lifecycle
+        #: spans reuse the latest clock value the fleet already read
+        #: (``_t_last``) — tracing adds zero clock reads, so
+        #: VirtualClock-denominated deadline budgets are untouched.
+        self.tracer = (telemetry.Tracer(sink=self.sink, clock=self._clock)
+                       if trace else None)
+        self._t_last = 0.0
         self._chaos = chaos
         self.migration_retry = migration_retry
         self.replicas: List[Replica] = []
@@ -188,9 +198,10 @@ class ReplicaFleet:
                 sink=telemetry.TaggedRecorder(self.sink, replica_id=i,
                                               tp=self.tp),
                 clock=self._clock, chaos=chaos, tp=self.tp,
-                devices=devs, **engine_kw)
+                devices=devs, trace=trace, **engine_kw)
             self.replicas.append(Replica(idx=i, engine=eng))
         self._migrants: List[_Migrant] = []
+        self._last_route: Dict[str, Any] = {}
         self._migrated_rids: set = set()
         self._migrated_from: Dict[int, int] = {}
         self._swap_plan: Optional[dict] = None
@@ -205,6 +216,15 @@ class ReplicaFleet:
         self.steps_run = 0
         self._stalled_boundaries = 0
         self.last_stats: Dict[str, Any] = {}
+
+    def _read_clock(self) -> float:
+        """The fleet's only clock accessor: every read remembers its
+        value so lifecycle spans (drain/join/swap/restart) can be
+        stamped WITHOUT additional reads — VirtualClock sequences stay
+        byte-identical with tracing on or off."""
+        t = self._clock()
+        self._t_last = t
+        return t
 
     # -- router ------------------------------------------------------------
     def route(self, req: Request) -> Tuple[
@@ -229,6 +249,8 @@ class ReplicaFleet:
                 est = ctl.estimated_step_time_s if ctl is not None else 0.0
                 probed.append((steps, est, rep))
         if not probed:
+            self._last_route = {
+                "refused": {str(i): r.code.value for i, r in refusals}}
             return None, refusals
         # cost model: steps-to-first-token x EWMA step time. Replicas
         # without an estimate yet borrow the slowest measured one
@@ -239,6 +261,17 @@ class ReplicaFleet:
             ((steps * (est if est > 0 else default_est), r.idx, r)
              for steps, est, r in probed),
             key=lambda t: (t[0], t[1]))
+        # the decision record the "route" span carries: every probed
+        # replica's cost-model inputs + every refusal, so a waterfall
+        # shows WHY the router sent the request where it did
+        self._last_route = {
+            "costs": {str(r.idx): {
+                "steps": steps,
+                "est_step_s": round(est if est > 0 else default_est, 6),
+                "cost": round(steps * (est if est > 0 else default_est),
+                              6)} for steps, est, r in probed},
+            "refused": {str(i): r.code.value for i, r in refusals},
+        }
         return rep, refusals
 
     def try_submit(self, req: Request) -> Optional[RejectionReason]:
@@ -248,7 +281,7 @@ class ReplicaFleet:
         been waiting since first submit. When no replica is feasible
         the request is finalized ``REJECTED`` with the fleet-level
         ``NO_FEASIBLE_REPLICA`` reason naming each replica's refusal."""
-        now = self._clock()
+        now = self._read_clock()
         migrating = any(m.req is req for m in self._migrants)
         if (req.status in (RequestStatus.QUEUED, RequestStatus.RUNNING)
                 or migrating):
@@ -271,13 +304,26 @@ class ReplicaFleet:
             req.end_reason = None
         if req.t_arrival is None:
             req.t_arrival = now
+        ctx = None
+        if self.tracer is not None:
+            # the fleet stamps the trace identity; the engine's own
+            # begin_request_trace is then a no-op (idempotent), so the
+            # router hop and the engine hops share ONE tree
+            ctx = self.tracer.begin_request_trace(req)
+            telemetry.attr_init(req, now)
+            telemetry.attr_account(req, now, "queue_wait")
         rep, refusals = self.route(req)
+        if self.tracer is not None and ctx is not None:
+            self.tracer.emit(
+                "route", ctx.trace_id, now, now, parent_id=ctx.span_id,
+                chosen=(rep.idx if rep is not None else None),
+                **self._last_route)
         if rep is None:
             reason = self._no_replica_reason(req, refusals)
             self.sink.record({"event": "reject", "rid": req.rid,
                               **reason.as_record()})
             self._finalize(req, RequestStatus.REJECTED,
-                           reason.code.value)
+                           reason.code.value, now=now)
             return reason
         reason = rep.engine.try_submit(req)
         if reason is None:
@@ -310,12 +356,16 @@ class ReplicaFleet:
 
     # -- lifecycle (fleet-held requests) -----------------------------------
     def _finalize(self, req: Request, status: RequestStatus,
-                  reason: str) -> None:
+                  reason: str, *, now: Optional[float] = None,
+                  term: str = "queue_wait") -> None:
         """Finalize a request the fleet holds (fleet-rejected, or a
         migrant that could not be placed) — same double-finalize guard
         and ``request_end`` schema as the engine's (no ``t_done``
         stamp: the fleet never finalizes COMPLETED, the only status
-        the engine timestamps)."""
+        the engine timestamps). ``now`` is the clock value the caller
+        already read (never re-read here); ``term`` names the
+        attribution bucket for the final interval — "migration" on the
+        migrant paths, "queue_wait" on router rejects."""
         if is_terminal(req.status):
             raise AssertionError(
                 f"request {req.rid} finalized twice "
@@ -329,6 +379,14 @@ class ReplicaFleet:
             "preemptions": req.preemptions,
             "restarts": req.restarts,
         })
+        if self.tracer is not None:
+            t = now if now is not None else getattr(
+                req, "_t_attr", req.t_arrival)
+            if t is None:
+                t = self._t_last
+            telemetry.spans.emit_terminal_span(
+                self.tracer, req, status.value, reason, now=t,
+                term=term, slo_ok=ServingEngine._within_budget(req))
 
     # -- drain / join ------------------------------------------------------
     def drain(self, replica_id: int) -> None:
@@ -345,6 +403,16 @@ class ReplicaFleet:
                           "in_flight": rep.engine.scheduler.n_active,
                           "queued":
                           len(rep.engine.scheduler.waiting)})
+        if self.tracer is not None:
+            # lifecycle spans are stamped with the latest clock value
+            # the fleet already read (zero extra reads); one shared
+            # trace holds the whole drain -> swap -> join story
+            self.tracer.emit(
+                "replica_drain", "fleet-lifecycle", self._t_last,
+                self._t_last, replica_id=replica_id,
+                in_flight=rep.engine.scheduler.n_active,
+                queued=len(rep.engine.scheduler.waiting))
+        rep._drain_t0 = self._t_last
 
     def try_join(self, replica_id: int,
                  params: Optional[Pytree] = None) -> bool:
@@ -377,6 +445,14 @@ class ReplicaFleet:
         rep.state = ReplicaState.ACTIVE
         self.sink.record({"event": "replica_join",
                           "replica_id": replica_id})
+        if self.tracer is not None:
+            # drain -> join as ONE span: t_start is the clock value
+            # remembered at drain(), t_end the latest fleet read
+            self.tracer.emit(
+                "replica_join", "fleet-lifecycle",
+                getattr(rep, "_drain_t0", self._t_last), self._t_last,
+                replica_id=replica_id, swapped=params is not None,
+                swaps=rep.swaps)
         return True
 
     def schedule_rolling_update(self, params: Pytree) -> None:
@@ -450,6 +526,13 @@ class ReplicaFleet:
                               "swapped":
                               [r.idx for r in self.replicas
                                if r.swaps > 0]})
+            if self.tracer is not None:
+                self.tracer.emit(
+                    "rolling_update_done", "fleet-lifecycle",
+                    self._t_last, self._t_last,
+                    swapped=[r.idx for r in self.replicas
+                             if r.swaps > 0],
+                    missed=sorted(self._missed_swaps))
 
     # -- replica failure + migration ---------------------------------------
     def _on_replica_death(self, rep: Replica, err: BaseException,
@@ -469,11 +552,30 @@ class ReplicaFleet:
             "in_flight": len(survivors),
             "rids": [r.rid for r in survivors],
         })
-        now = self._clock()
+        now = self._read_clock()
+        rep._death_t = now
+        if self.tracer is not None:
+            # the dead engine's flight ring IS the black box: replay it
+            # into the shared sink (tagged with the replica id by the
+            # engine's own TaggedRecorder tags) before the engine is
+            # abandoned, stacks-style post-mortem for replica chaos
+            dead_tracer = getattr(rep.engine, "tracer", None)
+            if dead_tracer is not None:
+                dead_tracer.dump_blackbox(
+                    reason="replica_down", sink=self.sink,
+                    replica_id=rep.idx, step=fleet_step,
+                    error=f"{type(err).__name__}: {err}")
         for r in survivors:
             self._migrants.append(
                 _Migrant(req=r, from_replica=rep.idx, t0=now))
             self._migrated_rids.add(r.rid)
+            if self.tracer is not None:
+                # from the death instant the request is in migration
+                # limbo: account the tail of its on-replica interval
+                # now, and tell the NEXT engine's try_submit (which
+                # accounts up to its own admit instant) the same
+                telemetry.attr_account(r, now, "migration")
+                r._migrating = True
             self.sink.record({"event": "migrate", "rid": r.rid,
                               "from_replica": rep.idx,
                               "generated": len(r.out_tokens)})
@@ -505,6 +607,12 @@ class ReplicaFleet:
         self.sink.record({"event": "replica_restart",
                           "replica_id": replica_id,
                           "dead_steps_run": old.steps_run})
+        if self.tracer is not None:
+            self.tracer.emit(
+                "replica_restart", "fleet-lifecycle",
+                getattr(rep, "_death_t", self._t_last), self._t_last,
+                replica_id=replica_id, dead_steps_run=old.steps_run,
+                swapped=pending is not None)
 
     def _place_migrants(self, now: float) -> None:
         """One placement attempt per waiting migrant: expired requests
@@ -519,13 +627,20 @@ class ReplicaFleet:
         still: List[_Migrant] = []
         for m in self._migrants:
             req = m.req
+            if self.tracer is not None:
+                # still in limbo at this boundary: keep the ledger's
+                # cursor current so however the migrant ends (placed,
+                # expired, exhausted) the wait is already attributed
+                telemetry.attr_account(req, now, "migration")
             why = request_expired(req, now)
             if why is not None:
-                self._finalize(req, RequestStatus.TIMED_OUT, why)
+                self._finalize(req, RequestStatus.TIMED_OUT, why,
+                               now=now, term="migration")
                 continue
             if not any_live:
                 self._finalize(req, RequestStatus.FAILED,
-                               "no_live_replica")
+                               "no_live_replica", now=now,
+                               term="migration")
                 continue
             rep, refusals = self.route(req)
             if rep is not None:
@@ -538,6 +653,15 @@ class ReplicaFleet:
                         "from_replica": m.from_replica,
                         "replica_id": rep.idx,
                         "attempts": m.attempts + 1})
+                    ctx = getattr(req, "trace", None)
+                    if self.tracer is not None and ctx is not None:
+                        self.tracer.emit(
+                            "migration", ctx.trace_id, m.t0, now,
+                            parent_id=ctx.span_id,
+                            from_replica=m.from_replica,
+                            to_replica=rep.idx,
+                            attempts=m.attempts + 1,
+                            generated=len(req.out_tokens))
                 # an engine-side refusal finalized the request REJECTED
                 # (shed-by-admission is a terminal outcome, not a retry
                 # loop — the probe said feasible, so this only happens
@@ -559,7 +683,8 @@ class ReplicaFleet:
                     "event": "migrate_exhausted", "rid": req.rid,
                     "attempts": m.attempts, **reason.as_record()})
                 self._finalize(req, RequestStatus.REJECTED,
-                               "migration_exhausted")
+                               "migration_exhausted", now=now,
+                               term="migration")
                 continue
             still.append(m)
         self._migrants = still
@@ -580,7 +705,7 @@ class ReplicaFleet:
         ``HangError``) and migrating its in-flight work."""
         step = self.steps_run
         self._advance_swap_plan()
-        self._place_migrants(self._clock())
+        self._place_migrants(self._read_clock())
         # stall guard: migrants waiting, no ACTIVE replica to take
         # them, no swap plan that would auto-join one, and every live
         # engine idle — nothing can change without outside action, so
@@ -595,14 +720,15 @@ class ReplicaFleet:
                         for r in self.replicas if r.live)):
             self._stalled_boundaries += 1
             if self._stalled_boundaries >= 8:
-                now = self._clock()
+                now = self._read_clock()
                 for m in self._migrants:
                     self.sink.record({
                         "event": "migrate_exhausted", "rid": m.req.rid,
                         "attempts": m.attempts,
                         "code": "no_active_replica"})
                     self._finalize(m.req, RequestStatus.FAILED,
-                                   "no_active_replica")
+                                   "no_active_replica", now=now,
+                                   term="migration")
                 self._migrants = []
         else:
             self._stalled_boundaries = 0
@@ -662,7 +788,7 @@ class ReplicaFleet:
             # boundary either way)
             if (self._migrants and pending
                     and pending[0].arrival_step <= step):
-                self._place_migrants(self._clock())
+                self._place_migrants(self._read_clock())
             while pending and pending[0].arrival_step <= step:
                 self.try_submit(pending.pop(0))
             if not pending and not self.busy:
@@ -834,5 +960,13 @@ class ReplicaFleet:
             "decode_tokens_per_step": (
                 round(fleet_decode_tokens / fleet_decode_slot_steps, 4)
                 if fleet_decode_slot_steps else None),
+            # fleet-level latency attribution: the same exact-sum
+            # ledger the engines fill, folded over every OFFERED
+            # request (migration limbo shows up as its own term here —
+            # a single engine never sees it)
+            "attribution": telemetry.attribution_summary(
+                reqs, violators=[
+                    r for r in reqs
+                    if not ServingEngine._within_budget(r)]),
             "per_replica": per_replica,
         }

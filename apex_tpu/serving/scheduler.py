@@ -112,6 +112,13 @@ class Request:
     # (younger never preempts older, so the most senior request always
     # progresses)
     admit_seq: Optional[int] = None
+    # tracing (telemetry.spans): the TraceContext stamped at submit and
+    # carried across engines/migrations, plus the latency-attribution
+    # ledgers — running end-to-end terms and the TTFT-instant snapshot
+    # (both partition measured wall time over spans.ATTR_TERMS)
+    trace: Optional[object] = None
+    attr: Optional[dict] = None
+    attr_ttft: Optional[dict] = None
 
     @property
     def done(self) -> bool:
@@ -135,6 +142,16 @@ class RunningSlot:
     # memoized chain digests (digests[j] names prompt[:page-j end]) so
     # publication hashes each token once per slot, not once per page
     digests: List[bytes] = dataclasses.field(default_factory=list)
+    # attribution: this admission re-prefills work a disruption already
+    # paid for (preemption/restart replay) — prefill intervals bucket
+    # to "replay" instead of "prefill_compute"
+    replay: bool = False
+    # the boundary timestamp at which the engine admitted this slot
+    # (span t_start for the prefill span; None outside tracing)
+    t_admit: Optional[float] = None
+    # attribution: the first post-admission interval of a cache-hit
+    # admission buckets to "cached_skip" exactly once
+    hit_attributed: bool = False
 
     @property
     def prefilling(self) -> bool:
@@ -301,7 +318,8 @@ class Scheduler:
                 req.admit_seq = next(self._admit_seq)
             run = RunningSlot(req=req, prompt=list(req.prompt)
                               + list(req.out_tokens),
-                              admit_seq=req.admit_seq)
+                              admit_seq=req.admit_seq,
+                              replay=(req.preemptions + req.restarts) > 0)
             reason = self.validate(req, len(run.prompt))
             if reason is not None:
                 # unreachable for submit()-validated requests (replay

@@ -16,6 +16,11 @@ Four parts, designed so instrumentation costs nothing on the hot path:
 - :mod:`~apex_tpu.telemetry.pipeline` — pipeline bubble accounting:
   analytic warmup/steady/cooldown timelines per rank and a measured
   :class:`TickTimeline` fed by the schedules' ``tick_hook``;
+- :mod:`~apex_tpu.telemetry.spans` — end-to-end request tracing over
+  the recorder sinks: :class:`Tracer`/:class:`TraceContext` span
+  records (deterministic under ``VirtualClock``), the exact-sum
+  latency-attribution ledger, and the bounded flight-recorder ring
+  dumped as a black box on hangs/crashes;
 - :mod:`~apex_tpu.telemetry.numerics` — the numerics health monitor:
   per-tensor overflow provenance (pytree and packed flat-buffer paths),
   opt-in activation-watch taps, and an anomaly-rule engine
@@ -56,6 +61,17 @@ from .recorder import (  # noqa: F401
     is_logging_process,
     percentiles,
     read_jsonl,
+    stamp_wall,
+)
+from .spans import (  # noqa: F401
+    ATTR_TERMS,
+    TraceContext,
+    Tracer,
+    attr_account,
+    attr_init,
+    attr_snapshot_ttft,
+    attribution_summary,
+    dominant_cause,
 )
 from .tracing import (  # noqa: F401
     TraceSession,
@@ -78,7 +94,9 @@ __all__ = [
     "classify_phase", "schedule_ticks", "tick_phases",
     "JsonlRecorder", "MultiRecorder", "NullRecorder",
     "RingBufferRecorder", "TaggedRecorder", "is_logging_process",
-    "percentiles", "read_jsonl",
+    "percentiles", "read_jsonl", "stamp_wall",
+    "ATTR_TERMS", "TraceContext", "Tracer", "attr_account", "attr_init",
+    "attr_snapshot_ttft", "attribution_summary", "dominant_cause",
     "TraceSession", "aggregate_op_times", "breakdown_table",
     "categorize_op", "cost_analysis_breakdown", "parse_xspace_op_times",
     "profile_step", "short_op_name", "trace_session",

@@ -70,6 +70,17 @@ def _jsonable(v):
     return [_jsonable(x) for x in arr.tolist()]
 
 
+def stamp_wall(rec: dict) -> dict:
+    """Stamp ``t_wall`` (wall-clock seconds) on a record in place,
+    keeping an existing value. The ONE place the cross-sink record
+    schema gets its timestamp — every sink that persists records
+    (:class:`JsonlRecorder`, :class:`RingBufferRecorder`) stamps here,
+    so ring-sourced flight-recorder dumps carry the same ``t_wall`` a
+    JSONL stream would."""
+    rec.setdefault("t_wall", time.time())
+    return rec
+
+
 class NullRecorder:
     """Drops everything (the non-logging ranks' sink)."""
 
@@ -104,7 +115,7 @@ class RingBufferRecorder(NullRecorder):
 
     def record(self, rec: dict) -> None:
         if self._enabled:
-            self.records.append(dict(rec))
+            self.records.append(stamp_wall(dict(rec)))
 
     def add_scalar(self, name, value, step) -> None:
         self.record({"event": "scalar", "name": str(name),
@@ -152,8 +163,7 @@ class JsonlRecorder(NullRecorder):
     def record(self, rec: dict) -> None:
         if self._fh is None:
             return
-        rec = {k: _jsonable(v) for k, v in rec.items()}
-        rec.setdefault("t_wall", time.time())
+        rec = stamp_wall({k: _jsonable(v) for k, v in rec.items()})
         line = json.dumps(rec)
         with self._lock:
             if self._fh is None:  # closed between check and write
@@ -189,10 +199,19 @@ class TaggedRecorder(NullRecorder):
     keys win over the tags (an event that already carries
     ``replica_id`` keeps it); ``add_scalar`` writes are tagged too (as
     ``scalar`` records, like the ring buffer does).
+
+    The tagger does NOT own the sink by default: a fleet hands every
+    replica a tagged view over ONE shared stream, so one replica's
+    teardown must not close the file out from under the others —
+    ``close()`` only flushes. A tagger that wraps a sink nobody else
+    holds (e.g. a fake host's private JSONL) passes ``owns_sink=True``
+    to get the close cascade back.
     """
 
-    def __init__(self, sink, tags: Optional[dict] = None, **tag_kw):
+    def __init__(self, sink, tags: Optional[dict] = None, *,
+                 owns_sink: bool = False, **tag_kw):
         self.sink = sink
+        self.owns_sink = owns_sink
         self.tags = {**(tags or {}), **tag_kw}
 
     def record(self, rec: dict) -> None:
@@ -206,7 +225,10 @@ class TaggedRecorder(NullRecorder):
         self.sink.flush()
 
     def close(self) -> None:
-        self.sink.close()
+        if self.owns_sink:
+            self.sink.close()
+        else:
+            self.sink.flush()
 
 
 class MultiRecorder(NullRecorder):
@@ -271,12 +293,29 @@ def percentiles(values, ps=(50, 90, 99), *, field=None):
             float(np.percentile(arr, p)) for p in ps}
 
 
-def read_jsonl(path) -> list:
-    """Parse a telemetry JSONL file back into a list of dicts."""
-    out = []
+def read_jsonl(path, *, stats: Optional[dict] = None) -> list:
+    """Parse a telemetry JSONL file back into a list of dicts.
+
+    Post-mortem hardened: a writer SIGKILLed mid-write leaves a torn
+    FINAL line, and the black box must still open — a truncated tail is
+    skipped (and counted in ``stats["torn_lines"]`` when a stats dict is
+    passed) instead of refusing the whole file. Corruption anywhere
+    before the final line is a different failure (the format is
+    append-only, a mid-file tear means the file is not what we wrote)
+    and still raises ``json.JSONDecodeError``.
+    """
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+        lines = [ln.strip() for ln in f]
+    out = []
+    torn = 0
+    nonempty = [i for i, ln in enumerate(lines) if ln]
+    for i in nonempty:
+        try:
+            out.append(json.loads(lines[i]))
+        except json.JSONDecodeError:
+            if i != nonempty[-1]:
+                raise
+            torn += 1
+    if stats is not None:
+        stats["torn_lines"] = stats.get("torn_lines", 0) + torn
     return out

@@ -783,6 +783,9 @@ def bench_serving():
         "kv_pool_mb": round(eng.spec.cache_bytes() / 2**20, 1),
         "prefill_chunk": st["prefill_chunk"],
         "prefix_hit_rate": (st["prefix_cache"] or {}).get("hit_rate"),
+        # the per-term latency decomposition (exact-sum ledger);
+        # compare_bench validates this block's schema
+        "attribution": st.get("attribution"),
     }
     tot = st["prefill_slot_steps"] + st["decode_slot_steps"]
     prefill_decode_split = {
@@ -801,6 +804,73 @@ def bench_serving():
     }
     return {"serving_throughput": serving_throughput,
             "prefill_decode_split": prefill_decode_split}
+
+
+def bench_trace_overhead():
+    """``trace_overhead`` leg: the serving engine's distributed-tracing
+    A/B — the SAME staggered request trace decoded twice, ``trace=False``
+    (bare) vs ``trace=True`` (span emission + the attribution ledger +
+    the flight ring, the PR-17 instrumentation), comparing median
+    engine-step time. Tracing reads no clocks of its own and emits spans
+    only at scheduling boundaries, so the claim compare_bench gates is
+    overhead <= 1% (1pp absolute tolerance). Skipped in fast mode unless
+    BENCH_TRACE_OVERHEAD=1 forces it (the CPU smoke configuration;
+    artifact committed under bench_artifacts/)."""
+    import numpy as _np
+
+    from apex_tpu.serving import Request, ServingEngine
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=16, hidden_size=1024,
+        vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+    rng = _np.random.default_rng(0)
+    prompts = [[int(t) for t in
+                rng.integers(0, cfg.vocab_size, size=prompt_len)]
+               for _ in range(n_req)]
+
+    def run(trace: bool):
+        reqs = [
+            Request(prompt=list(p), max_new_tokens=max_new,
+                    arrival_step=int(
+                        i * max(1, max_new // 2) // max(1, n_slots)))
+            for i, p in enumerate(prompts)]
+        # both arms stream into the bench telemetry JSONL: the A/B
+        # prices span emission through a REAL sink, not a null one
+        eng = ServingEngine(cfg, params, n_slots=n_slots,
+                            prefill_chunk=chunk, trace=trace,
+                            sink=telemetry_recorder())
+        eng.generate(reqs)
+        return eng.last_stats
+
+    bare = run(trace=False)       # warms the jit caches for both arms
+    instr = run(trace=True)
+    bare_ms = bare["step_ms"].get("p50") or 0.0
+    instr_ms = instr["step_ms"].get("p50") or 0.0
+    overhead_pct = ((instr_ms / bare_ms - 1.0) * 100.0
+                    if bare_ms > 0 else 0.0)
+    return {"trace_overhead": {
+        "bare_step_ms": round(bare_ms, 3),
+        "instrumented_step_ms": round(instr_ms, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_1pct": bool(overhead_pct <= 1.0),
+        "bare_tokens_per_sec": bare["tokens_per_sec"],
+        "instrumented_tokens_per_sec": instr["tokens_per_sec"],
+        "steps": instr["steps"],
+        "n_requests": n_req,
+        "layers": layers,
+    }}
 
 
 def bench_serving_overload():
@@ -1033,6 +1103,9 @@ def bench_serving_fleet():
         "prompt_len_mean": round(sum(plens) / len(plens), 1),
         "max_new_tokens": max_new,
         "layers": layers,
+        # fleet-level latency attribution (includes the migration term
+        # a single engine never sees); compare_bench validates schema
+        "attribution": st.get("attribution"),
     }}
 
 
@@ -2206,6 +2279,22 @@ def main() -> None:
             print(f"serving bench failed: {type(e).__name__}: {e}",
                   file=_sys.stderr)
 
+    # trace-overhead leg: the serving A/B pricing the PR-17 span/
+    # attribution instrumentation; acceptance is <= 1% (compare_bench
+    # gates trace_overhead_pct at 1pp absolute). Gated like the other
+    # overhead legs: fast mode skips unless BENCH_TRACE_OVERHEAD=1.
+    trace_overhead = None
+    if ((not fast or os.environ.get("BENCH_TRACE_OVERHEAD") == "1")
+            and want_serving != "0"):
+        try:
+            trace_overhead = _retry_transient(
+                bench_trace_overhead, tag="trace overhead leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"trace overhead bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     # overload leg: the same engine family at 2x the sustainable
     # arrival rate with admission control + deadlines armed — goodput,
     # SLO attainment, p99 TTFT, zero page leaks (serving.robustness).
@@ -2407,6 +2496,7 @@ def main() -> None:
         "telemetry_overhead": telemetry_overhead,
         "numerics_overhead": numerics_overhead,
         "resilience_overhead": resilience_overhead,
+        "trace_overhead": (trace_overhead or {}).get("trace_overhead"),
         "telemetry_jsonl": telemetry_recorder().path,
         "batch": batch,
         "seq": seq,

@@ -491,8 +491,10 @@ def test_tagged_recorder_injects_tags_record_keys_win():
     # dict-style tags compose with kwargs
     t2 = TaggedRecorder(ring, {"pod": "a"}, replica_id=0)
     t2.record({"event": "x"})
-    assert ring.events("x")[0] == {"event": "x", "pod": "a",
-                                   "replica_id": 0}
+    rec = ring.events("x")[0]
+    # every sink stamps t_wall (unified schema); tags compose around it
+    assert rec.pop("t_wall") > 0
+    assert rec == {"event": "x", "pod": "a", "replica_id": 0}
 
 
 def test_fleet_events_are_replica_attributable(tiny_model):

@@ -66,6 +66,10 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("serving_tp_p99_ms", "serving_tp.p99_ms", False),
     ("telemetry_overhead_pct", "telemetry_overhead.overhead_pct", False),
     ("resilience_overhead_pct", "resilience_overhead.overhead_pct", False),
+    # ISSUE-17 request tracing: the serving A/B pricing span emission +
+    # the attribution ledger + the flight ring; the <=1% claim is an
+    # absolute 1pp gate like the other overhead legs
+    ("trace_overhead_pct", "trace_overhead.overhead_pct", False),
     # ISSUE-14 flat-buffer gradient lifecycle A/B: the flat leg must stay
     # faster than the per-leaf historical step, and the XLA-cost-model
     # ratios must stay below parity (bytes_ratio < 1.0 is the acceptance
@@ -89,6 +93,7 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
 ABS_TOLERANCE = {
     "telemetry_overhead_pct": 1.0,  # percentage points (the <=1% claim)
     "resilience_overhead_pct": 1.0,  # ditto (docs/resilience.md)
+    "trace_overhead_pct": 1.0,  # ditto (docs/observability.md tracing)
     # the zero-loss failover contract: the expected value is exactly 0,
     # so ONE lost request must regress — a relative threshold over a
     # zero base would wave any count through (or inf-flag noise)
@@ -146,6 +151,54 @@ def category_shift(base_pcts: Dict[str, float],
                        "delta_pp": round(n - b, 2)})
     shifts.sort(key=lambda s: -s["delta_pp"])
     return shifts
+
+
+# the latency-attribution partition (must mirror
+# apex_tpu.telemetry.ATTR_TERMS — duplicated here so the gate works on
+# archived captures without importing the package)
+ATTR_TERMS = ("queue_wait", "cached_skip", "prefill_compute", "decode",
+              "replay", "migration")
+
+# legs that carry an ``attribution`` block (ISSUE-17); absent blocks are
+# fine (old captures, tracing off), malformed ones are schema drift
+ATTRIBUTED_LEGS = ("serving_throughput", "serving_fleet")
+
+
+def attribution_problems(bench: Optional[dict]) -> List[str]:
+    """Schema-validate the ``attribution`` summary carried by the
+    serving legs: the full term set, per-term percentile dicts, and the
+    exact-sum identity (``ttft_sum_rel_err_max`` <= 1%) — the contract
+    docs/observability.md promises downstream dashboards."""
+    problems: List[str] = []
+    for leg in ATTRIBUTED_LEGS:
+        att = _dig(bench or {}, f"{leg}.attribution")
+        if att is None:
+            continue
+        if not isinstance(att, dict):
+            problems.append(f"{leg}.attribution: not a dict")
+            continue
+        if tuple(att.get("terms") or ()) != ATTR_TERMS:
+            problems.append(
+                f"{leg}.attribution.terms != {list(ATTR_TERMS)}")
+        for block in ("ttft_ms", "e2e_ms"):
+            d = att.get(block)
+            if not isinstance(d, dict) or set(d) != set(ATTR_TERMS):
+                problems.append(
+                    f"{leg}.attribution.{block}: missing/extra terms")
+                continue
+            for t, p in d.items():
+                if not isinstance(p, dict) or not {
+                        "p50", "p90", "p99"} <= set(p):
+                    problems.append(
+                        f"{leg}.attribution.{block}.{t}: "
+                        "missing percentiles")
+                    break
+        err = att.get("ttft_sum_rel_err_max")
+        if not isinstance(err, (int, float)) or err > 0.01:
+            problems.append(
+                f"{leg}.attribution.ttft_sum_rel_err_max={err!r} "
+                "(terms must sum to measured TTFT within 1%)")
+    return problems
 
 
 def _dig(d: dict, path: str):
@@ -274,6 +327,14 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
             "new": False,
             "codes": an.get("codes"),
         })
+    # attribution-summary schema (ISSUE-17): a NEW capture whose serving
+    # legs carry a malformed attribution block — or one whose terms no
+    # longer sum to the measured TTFT — is drift, flagged like a perf leg
+    attr_probs = attribution_problems(new)
+    if attr_probs:
+        regressions.append({"leg": "attribution_schema",
+                            "base": None, "new": False,
+                            "problems": attr_probs})
     return {
         "threshold_pct": round(100.0 * threshold, 2),
         "regressions": regressions,

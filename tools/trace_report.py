@@ -1,0 +1,471 @@
+"""trace_report: waterfalls, latency attribution, and causality
+validation over apex_tpu span streams.
+
+Every ``event == "span"`` record in a telemetry JSONL (the serving
+sinks, the elastic checkpoint/supervisor sinks, a flight-recorder black
+box) is one closed span; this tool groups them into traces, validates
+causality, and renders the result:
+
+    python tools/trace_report.py events.jsonl             # report
+    python tools/trace_report.py events.jsonl --json
+    python tools/trace_report.py events.jsonl --waterfall req-3
+    python tools/trace_report.py --self                   # smokes
+    python tools/trace_report.py --self --check chaos_fleet_trace
+
+Validation is the point, not a side effect: the exit code is non-zero
+when the stream's causality is broken —
+
+- **orphan spans**: a ``parent_id`` that resolves to no span in the
+  same trace (a hop emitted outside its request's tree);
+- **unterminated requests**: a ``req-*`` trace with zero or more than
+  one ``terminal`` span (every offered request must end exactly once);
+- **non-monotone timestamps**: ``t_end < t_start`` on any span;
+- **duplicate span ids** among live (non-black-box-replay) spans.
+
+Black-box replays (``blackbox_replay: true``) are post-mortem COPIES of
+spans that may also exist in the live stream; they are deduplicated by
+``(trace_id, span_id)`` before validation so a crash dump never reads
+as a duplicate-id violation.
+
+Exit codes (CI contract, same as serving_check/resilience_check):
+0 = valid / all checks pass, 1 = broken causality or a failed check,
+2 = infra/usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# tools/ itself, so `from serving_check import ...` resolves when this
+# module is imported as `tools.trace_report` (tier-1 tests) rather than
+# run as a script.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# trace assembly
+
+def build_traces(records) -> Dict[str, List[dict]]:
+    """Group span records by trace id, deduplicating black-box replays
+    against the live stream by ``(trace_id, span_id)`` (first record
+    wins — the live span precedes its post-mortem copy)."""
+    traces: Dict[str, List[dict]] = {}
+    seen: set = set()
+    for rec in records:
+        if rec.get("event") != "span":
+            continue
+        key = (rec.get("trace_id"), rec.get("span_id"))
+        if key in seen:
+            continue
+        seen.add(key)
+        traces.setdefault(str(rec.get("trace_id")), []).append(rec)
+    for spans in traces.values():
+        spans.sort(key=lambda s: (s.get("t_start", 0.0),
+                                  str(s.get("span_id"))))
+    return traces
+
+
+def validate(traces: Dict[str, List[dict]]) -> List[str]:
+    """Every causality problem in the stream, as human-readable
+    strings; an empty list means the trace set is sound."""
+    problems: List[str] = []
+    for tid, spans in sorted(traces.items()):
+        ids = [s.get("span_id") for s in spans]
+        id_set = set(ids)
+        if len(ids) != len(id_set):
+            dupes = sorted({str(i) for i in ids if ids.count(i) > 1})
+            problems.append(
+                f"{tid}: duplicate span id(s) {', '.join(dupes)}")
+        for s in spans:
+            pid = s.get("parent_id")
+            if pid is not None and pid not in id_set:
+                problems.append(
+                    f"{tid}: orphan span {s.get('span_id')} "
+                    f"({s.get('name')}) parent {pid} not in trace")
+            t0, t1 = s.get("t_start"), s.get("t_end")
+            if t0 is None or t1 is None or t1 < t0:
+                problems.append(
+                    f"{tid}: non-monotone span {s.get('span_id')} "
+                    f"({s.get('name')}): t_start={t0} t_end={t1}")
+        if tid.startswith("req-"):
+            n_term = sum(bool(s.get("terminal")) for s in spans)
+            if n_term != 1:
+                problems.append(
+                    f"{tid}: {n_term} terminal spans (every request "
+                    "trace must end exactly once)")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+def render_waterfall(spans: List[dict], width: int = 48) -> List[str]:
+    """One trace as an indented text waterfall: root spans at depth 0,
+    children under their parents, bars scaled to the trace extent."""
+    by_id = {s.get("span_id"): s for s in spans}
+    children: Dict[object, List[dict]] = {}
+    roots: List[dict] = []
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid is not None and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    t_lo = min(s.get("t_start", 0.0) for s in spans)
+    t_hi = max(s.get("t_end", 0.0) for s in spans)
+    span_s = max(t_hi - t_lo, 1e-12)
+    lines: List[str] = []
+
+    def emit(s: dict, depth: int) -> None:
+        t0, t1 = s.get("t_start", 0.0), s.get("t_end", 0.0)
+        lo = int(round((t0 - t_lo) / span_s * width))
+        hi = max(int(round((t1 - t_lo) / span_s * width)), lo + 1)
+        bar = " " * lo + "#" * (hi - lo)
+        name = "  " * depth + str(s.get("name"))
+        mark = " *" if s.get("terminal") else ""
+        lines.append(f"  {name:<24.24} |{bar:<{width + 1}}| "
+                     f"{1e3 * (t1 - t0):8.2f} ms{mark}")
+        for c in sorted(children.get(s.get("span_id"), []),
+                        key=lambda x: (x.get("t_start", 0.0),
+                                       str(x.get("span_id")))):
+            emit(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: (x.get("t_start", 0.0),
+                                          str(x.get("span_id")))):
+        emit(r, 0)
+    return lines
+
+
+def attribution_table(traces: Dict[str, List[dict]]) -> Optional[dict]:
+    """Fold the terminal request spans' ``attr_ms`` / ``attr_ttft_ms``
+    breakdowns into per-term percentiles + a dominant-cause tally over
+    spans flagged ``slo_violated`` — the file-side twin of the live
+    ``attribution`` summary block."""
+    from apex_tpu.telemetry import ATTR_TERMS, percentiles
+
+    attr: List[dict] = []
+    ttft: List[dict] = []
+    causes: Dict[str, int] = {}
+    for tid, spans in traces.items():
+        if not tid.startswith("req-"):
+            continue
+        for s in spans:
+            if not s.get("terminal") or "attr_ms" not in s:
+                continue
+            attr.append(s["attr_ms"])
+            if "attr_ttft_ms" in s:
+                ttft.append(s["attr_ttft_ms"])
+            cause = s.get("dominant_cause")
+            if s.get("slo_violated") and cause:
+                causes[cause] = causes.get(cause, 0) + 1
+    if not attr:
+        return None
+    return {
+        "terms": list(ATTR_TERMS),
+        "n_attributed": len(attr),
+        "e2e_ms": {t: percentiles([a.get(t, 0.0) for a in attr])
+                   for t in ATTR_TERMS},
+        "ttft_ms": {t: percentiles([a.get(t, 0.0) for a in ttft])
+                    for t in ATTR_TERMS},
+        "dominant_causes": causes,
+    }
+
+
+def report(path: str, *, waterfall: Optional[str] = None,
+           max_waterfalls: int = 3) -> Tuple[dict, List[str]]:
+    """Load + validate one span stream; returns ``(summary, lines)``
+    where lines is the rendered text report."""
+    from apex_tpu.telemetry import read_jsonl
+
+    stats: Dict[str, int] = {}
+    records = read_jsonl(path, stats=stats)
+    traces = build_traces(records)
+    problems = validate(traces)
+    blackboxes = [r for r in records if r.get("event") == "blackbox"]
+    summary = {
+        "path": path,
+        "records": len(records),
+        "torn_lines": stats.get("torn_lines", 0),
+        "traces": len(traces),
+        "spans": sum(len(s) for s in traces.values()),
+        "request_traces": sum(tid.startswith("req-") for tid in traces),
+        "blackboxes": [{"reason": b.get("reason"),
+                        "n_spans": b.get("n_spans")}
+                       for b in blackboxes],
+        "attribution": attribution_table(traces),
+        "problems": problems,
+        "ok": not problems,
+    }
+    lines = [f"trace report: {path}",
+             f"  {summary['spans']} spans in {summary['traces']} traces "
+             f"({summary['request_traces']} requests, "
+             f"{len(blackboxes)} black boxes, "
+             f"{summary['torn_lines']} torn tail line(s))"]
+    shown = 0
+    for tid in sorted(traces):
+        if waterfall is not None:
+            if tid != waterfall:
+                continue
+        elif not tid.startswith("req-") or shown >= max_waterfalls:
+            continue
+        lines.append(f"\n{tid}:")
+        lines.extend(render_waterfall(traces[tid]))
+        shown += 1
+    att = summary["attribution"]
+    if att is not None:
+        lines.append("\nlatency attribution (ms, e2e p50/p90/p99):")
+        for t in att["terms"]:
+            p = att["e2e_ms"][t]
+            lines.append(
+                f"  {t:<16} {p.get('p50', 0.0):9.2f} "
+                f"{p.get('p90', 0.0):9.2f} {p.get('p99', 0.0):9.2f}")
+        if att["dominant_causes"]:
+            lines.append(f"  dominant causes on SLO violators: "
+                         f"{att['dominant_causes']}")
+    if problems:
+        lines.append("\nBROKEN CAUSALITY:")
+        lines.extend(f"  {p}" for p in problems)
+    else:
+        lines.append("\ncausality: OK")
+    return summary, lines
+
+
+# ---------------------------------------------------------------------------
+# self-checks (--self): the observability stack on its own traces
+
+def _chaos_fleet_records():
+    """One deterministic chaos fleet run (replica kill mid-trace,
+    forced preemption, prefix eviction) under VirtualClock; returns
+    (records, requests, fleet)."""
+    from serving_check import _tiny_cfg, _tiny_params
+
+    from apex_tpu import telemetry
+    from apex_tpu.resilience.chaos import ServingChaos
+    from apex_tpu.serving import Request
+    from apex_tpu.serving.fleet import ReplicaFleet
+    from apex_tpu.serving.robustness import VirtualClock
+
+    cfg = _tiny_cfg()
+    params = _tiny_params(cfg)
+    sink = telemetry.RingBufferRecorder(capacity=100000)
+    chaos = ServingChaos()
+    chaos.kill_replica_at(0, 2)
+    chaos.evict_prefix_cache(2)
+    fleet = ReplicaFleet(cfg, params, n_replicas=2, sink=sink,
+                         clock=VirtualClock(dt=0.01), chaos=chaos,
+                         n_slots=2, num_pages=64)
+    shared = [1, 2, 3, 4]
+    reqs = [Request(rid=i, prompt=shared[: 2 + (i % 2)] + [5 + i],
+                    max_new_tokens=4, arrival_step=i % 3)
+            for i in range(8)]
+    fleet.generate(reqs, max_steps=500)
+    return list(sink.records), reqs, fleet
+
+
+def check_chaos_fleet_trace() -> dict:
+    """The acceptance trace: a chaos fleet (kill + eviction) under
+    VirtualClock yields complete span trees — zero orphans, exactly one
+    terminal span per offered request, monotone timestamps — and the
+    TTFT attribution terms sum to the measured TTFT within 1%."""
+    records, reqs, fleet = _chaos_fleet_records()
+    traces = build_traces(records)
+    problems = validate(traces)
+    missing = [r.rid for r in reqs
+               if getattr(r, "trace", None) is None
+               or r.trace.trace_id not in traces]
+    unterminated = [
+        tid for tid, spans in traces.items() if tid.startswith("req-")
+        and sum(bool(s.get("terminal")) for s in spans) != 1]
+    rel_errs = []
+    for r in reqs:
+        if r.t_first_token is None or r.attr_ttft is None:
+            continue
+        measured = r.t_first_token - r.t_arrival
+        if measured > 0:
+            rel_errs.append(
+                abs(sum(r.attr_ttft.values()) - measured) / measured)
+    rel_err = max(rel_errs, default=0.0)
+    att = fleet.last_stats.get("attribution")
+    ok = (not problems and not missing and not unterminated
+          and rel_err <= 0.01 and rel_errs and att is not None
+          and fleet.replica_deaths >= 1)
+    return {"ok": bool(ok), "problems": problems[:5],
+            "missing_traces": missing, "unterminated": unterminated,
+            "ttft_sum_rel_err_max": rel_err,
+            "replica_deaths": fleet.replica_deaths,
+            "n_spans": sum(len(s) for s in traces.values())}
+
+
+def check_detects_broken_causality() -> dict:
+    """The validator itself: a synthetic stream seeded with an orphan
+    span, an unterminated request trace, and a non-monotone span must
+    be flagged — three distinct problems, none missed."""
+    records = [
+        # sound trace (must NOT be flagged)
+        {"event": "span", "name": "request", "trace_id": "req-0",
+         "span_id": 1, "parent_id": None, "t_start": 0.0, "t_end": 2.0,
+         "terminal": True},
+        {"event": "span", "name": "prefill", "trace_id": "req-0",
+         "span_id": 2, "parent_id": 1, "t_start": 0.5, "t_end": 1.0,
+         "terminal": False},
+        # orphan: parent 99 does not exist
+        {"event": "span", "name": "admit", "trace_id": "req-1",
+         "span_id": 3, "parent_id": 99, "t_start": 0.0, "t_end": 1.0,
+         "terminal": True},
+        # unterminated request trace
+        {"event": "span", "name": "route", "trace_id": "req-2",
+         "span_id": 4, "parent_id": None, "t_start": 0.0, "t_end": 0.0,
+         "terminal": False},
+        # non-monotone
+        {"event": "span", "name": "step", "trace_id": "engine-steps",
+         "span_id": 5, "parent_id": None, "t_start": 3.0, "t_end": 1.0,
+         "terminal": False},
+    ]
+    problems = validate(build_traces(records))
+    caught = {
+        "orphan": any("orphan" in p for p in problems),
+        "unterminated": any("terminal" in p and "req-2" in p
+                            for p in problems),
+        "non_monotone": any("non-monotone" in p for p in problems),
+        "clean_trace_clean": not any("req-0" in p for p in problems),
+    }
+    return {"ok": all(caught.values()), **caught,
+            "n_problems": len(problems)}
+
+
+def check_blackbox_torn_tail() -> dict:
+    """The crash path end to end: a flight-recorder black box written
+    to disk, its final line torn mid-record (the crash), must still
+    load — torn tail tolerated and counted, every intact span
+    readable."""
+    import tempfile
+
+    from apex_tpu.telemetry import Tracer, read_jsonl
+
+    tracer = Tracer(ring_capacity=16)
+    for i in range(5):
+        tracer.emit("engine_step", "engine-steps", float(i),
+                    float(i) + 0.5, ring_only=True, step=i)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "blackbox.jsonl")
+        tracer.dump_blackbox(reason="hang", path=path,
+                             stacks="Thread 1: ...\n  in run_step")
+        with open(path, "a") as f:
+            f.write('{"event": "span", "name": "torn')  # the crash
+        stats: Dict[str, int] = {}
+        records = read_jsonl(path, stats=stats)
+    header = records[0] if records else {}
+    spans = [r for r in records if r.get("event") == "span"]
+    ok = (stats.get("torn_lines") == 1 and len(spans) == 5
+          and header.get("event") == "blackbox"
+          and header.get("reason") == "hang"
+          and "stacks" in header)
+    return {"ok": bool(ok), "torn_lines": stats.get("torn_lines"),
+            "spans_recovered": len(spans),
+            "header_reason": header.get("reason")}
+
+
+def check_report_roundtrip() -> dict:
+    """report() over a real chaos-fleet stream written to disk: loads,
+    validates clean, renders waterfalls + the attribution table, and
+    agrees with the in-memory span count."""
+    import tempfile
+
+    from apex_tpu.telemetry import JsonlRecorder
+
+    records, reqs, fleet = _chaos_fleet_records()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "events.jsonl")
+        rec = JsonlRecorder(path)
+        for r in records:
+            rec.record(r)
+        rec.close()
+        summary, lines = report(path)
+    att = summary["attribution"]
+    ok = (summary["ok"] and summary["request_traces"] == len(reqs)
+          and att is not None and att["n_attributed"] == len(reqs)
+          and any("#" in ln for ln in lines))
+    return {"ok": bool(ok), "problems": summary["problems"][:5],
+            "request_traces": summary["request_traces"],
+            "spans": summary["spans"]}
+
+
+CHECKS = {
+    "chaos_fleet_trace": check_chaos_fleet_trace,
+    "detects_broken_causality": check_detects_broken_causality,
+    "blackbox_torn_tail": check_blackbox_torn_tail,
+    "report_roundtrip": check_report_roundtrip,
+}
+
+
+def run_checks(names=None) -> dict:
+    out = {"event": "trace_report_check", "checks": {}}
+    ok = True
+    for name in (list(names) if names else sorted(CHECKS)):
+        res = CHECKS[name]()
+        out["checks"][name] = res
+        ok = ok and bool(res["ok"])
+    out["ok"] = ok
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Waterfalls, latency attribution, and causality "
+                    "validation over apex_tpu span streams")
+    ap.add_argument("path", nargs="?",
+                    help="telemetry JSONL (span stream / black box)")
+    ap.add_argument("--self", action="store_true", dest="self_check",
+                    help="run the built-in tracing smokes")
+    ap.add_argument("--check", action="append", choices=sorted(CHECKS),
+                    help="restrict --self to specific check(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full result as JSON")
+    ap.add_argument("--waterfall", metavar="TRACE_ID",
+                    help="render only this trace's waterfall")
+    ap.add_argument("--max-waterfalls", type=int, default=3,
+                    help="request waterfalls to render (default 3)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        try:
+            result = run_checks(args.check)
+        except Exception as e:
+            print(f"trace_report check failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            for name, res in result["checks"].items():
+                status = "PASS" if res["ok"] else "FAIL"
+                detail = {k: v for k, v in res.items() if k != "ok"}
+                print(f"{status}  {name}  {detail}")
+            print("summary:", json.dumps({"ok": result["ok"]}))
+        return 0 if result["ok"] else 1
+
+    if not args.path:
+        ap.error("nothing to do: pass a telemetry JSONL or --self")
+    if not os.path.exists(args.path):
+        print(f"no such file: {args.path}", file=sys.stderr)
+        return 2
+    try:
+        summary, lines = report(args.path, waterfall=args.waterfall,
+                                max_waterfalls=args.max_waterfalls)
+    except Exception as e:
+        print(f"trace_report failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print("\n".join(lines))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
