@@ -611,7 +611,7 @@ class ElasticCheckpointManager(CheckpointManager):
         step_dir = self._step_dir(step)
         chaos = self.chaos
         if meta.get("emergency"):
-            self._write_commit_marker(step, meta)
+            self._write_commit_marker(step, meta, t_save_start)
             return
         deadline_policy = dataclasses.replace(
             self._barrier_policy, deadline=self.barrier_timeout_s)
@@ -648,7 +648,7 @@ class ElasticCheckpointManager(CheckpointManager):
             retry_call(all_shards_landed, policy=deadline_policy,
                        tag=f"elastic commit barrier step {step}",
                        sink=self._record)
-            self._write_commit_marker(step, meta)
+            self._write_commit_marker(step, meta, t_save_start)
         else:
             def committed():
                 if chaos is not None and hasattr(chaos, "in_barrier"):
@@ -679,9 +679,15 @@ class ElasticCheckpointManager(CheckpointManager):
                        tag=f"elastic commit wait step {step}",
                        sink=self._record)
 
-    def _write_commit_marker(self, step: int, meta: dict) -> None:
+    def _write_commit_marker(self, step: int, meta: dict,
+                             t_save_start: Optional[float] = None) -> None:
         """Promote ``step``: fsync'd marker named for the SAVED world
-        (``meta['world']`` — 1 for an emergency flush)."""
+        (``meta['world']`` — 1 for an emergency flush).
+        ``t_save_start`` (the attempt's wall-clock start, already read
+        in :meth:`_write`) turns the marker's own ``t_wall`` stamp into
+        a ``commit_latency_s`` on the event — the health plane's
+        checkpoint-commit-latency SLO feeds on it with zero clock reads
+        beyond the two the commit protocol already takes."""
         step_dir = self._step_dir(step)
         world = int(meta.get("world", self.world))
         commit = {"step": step, "world": world,
@@ -701,9 +707,13 @@ class ElasticCheckpointManager(CheckpointManager):
         os.rename(marker_tmp, os.path.join(step_dir, COMMIT_MARKER))
         fsync_dir(step_dir)
         fsync_dir(self.root)
-        self._emit({"event": "checkpoint_commit", "step": step,
-                    "world": world,
-                    "emergency": bool(meta.get("emergency"))})
+        rec = {"event": "checkpoint_commit", "step": step,
+               "world": world,
+               "emergency": bool(meta.get("emergency"))}
+        if t_save_start is not None:
+            rec["commit_latency_s"] = round(
+                commit["t_wall"] - t_save_start, 4)
+        self._emit(rec)
 
     def _is_emergency(self, step_dir: str) -> bool:
         marker = _read_json(os.path.join(step_dir, COMMIT_MARKER))

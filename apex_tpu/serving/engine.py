@@ -1003,6 +1003,19 @@ class ServingEngine:
             "preemptions": req.preemptions,
             "restarts": req.restarts,
         }
+        # health-plane enrichment (telemetry.timeseries consumes these):
+        # latencies from stamps the engine already took and the SLO
+        # verdict from static budgets — zero new clock reads here
+        if req.t_arrival is not None:
+            if req.t_first_token is not None:
+                rec["ttft_ms"] = round(
+                    1e3 * (req.t_first_token - req.t_arrival), 6)
+            rec["latency_ms"] = round(
+                1e3 * ((req.t_done if req.t_done is not None else now)
+                       - req.t_arrival), 6)
+        rec["slo_ok"] = self._within_budget(req)
+        if req.labels:
+            rec["labels"] = dict(req.labels)
         if failure is not None:
             rec["failure"] = dict(failure)
         self.sink.record(rec)
@@ -1043,12 +1056,30 @@ class ServingEngine:
                                      "prefill_compute"))
 
     def _boundary_degradation(self, now: float) -> None:
-        """Sustained pressure sheds queued work: deadline-infeasible
+        """Pressure degrades queued work. While the queue sits at/above
+        the high watermark (or backpressure is latched), waiting
+        requests are capped to the policy's ``cap_max_new`` — they have
+        not started decoding, so the cut frees real capacity (the
+        submit-path cap can never reach them: any submit that sees
+        pressure is refused by the same check). Past ``shed_after``
+        pressured boundaries, shedding starts: deadline-infeasible
         first, then lowest-priority-youngest, until the queue drains to
         the low watermark."""
         ctl = self.admission
         sched = self.scheduler
-        if not ctl.note_boundary(len(sched.waiting)):
+        shed_now = ctl.note_boundary(len(sched.waiting))
+        d = ctl.degradation
+        if (d is not None and d.cap_max_new is not None
+                and (ctl.backpressure
+                     or len(sched.waiting) >= ctl.high_count)):
+            for req in sched.waiting:
+                if req.max_new_tokens > d.cap_max_new:
+                    self.sink.record({
+                        "event": "degrade", "rid": req.rid,
+                        "max_new_tokens": int(d.cap_max_new),
+                        "requested_max_new": req.max_new_tokens})
+                    req.max_new_tokens = int(d.cap_max_new)
+        if not shed_now:
             return
         while len(sched.waiting) > ctl.low_count:
             victim = ctl.pick_shed_victim(sched.waiting,

@@ -147,6 +147,15 @@ class ReplicaFleet:
     (only those pacing knobs apply — there is no exception to filter).
     ``None`` retries until the request's own deadline (or the trace's
     ``max_steps`` guard) gives out.
+
+    ``health`` (a :class:`~apex_tpu.telemetry.alerts.HealthMonitor`)
+    arms the fleet health plane: the monitor's metrics aggregator is
+    fanned into the shared record stream, its SLO trackers are
+    evaluated once per scheduling boundary (with the boundary's
+    already-read clock value — zero new reads), and firing alerts
+    drive the fleet's own actuators (degradation, replica restart,
+    rolling-update abort) through the default
+    :class:`~apex_tpu.telemetry.alerts.FleetResponder`.
     """
 
     def __init__(
@@ -161,6 +170,7 @@ class ReplicaFleet:
         chaos=None,
         migration_retry=None,
         trace: bool = True,
+        health=None,
         **engine_kw,
     ):
         if n_replicas < 1:
@@ -176,6 +186,16 @@ class ReplicaFleet:
         #: is topology-blind: it only ever talks to engines.
         self.tp = int(tp)
         self.sink = sink if sink is not None else telemetry.NullRecorder()
+        #: fleet health plane (telemetry.alerts.HealthMonitor): the
+        #: monitor's aggregator is fanned INTO the record stream — every
+        #: replica-tagged engine event and fleet lifecycle event feeds
+        #: the metrics the SLO trackers evaluate — and its alert manager
+        #: is evaluated once per scheduling boundary with the clock
+        #: value the boundary already read (zero new clock reads).
+        self.health = health
+        if health is not None:
+            self.sink = telemetry.MultiRecorder(
+                self.sink, health.aggregator)
         self._clock = clock if clock is not None else time.perf_counter
         #: fleet-side tracing: the router/migration/rolling-update hops
         #: of every request's span tree (engines emit their own spans
@@ -216,6 +236,10 @@ class ReplicaFleet:
         self.steps_run = 0
         self._stalled_boundaries = 0
         self.last_stats: Dict[str, Any] = {}
+        if health is not None and health.fleet_responder is None:
+            # default actuator wiring: alert/response events land in
+            # the same fan-in stream, so they reach the aggregator too
+            health.attach_fleet(self, sink=self.sink)
 
     def _read_clock(self) -> float:
         """The fleet's only clock accessor: every read remembers its
@@ -372,13 +396,28 @@ class ReplicaFleet:
                 f"({req.status.name} -> {status.name})")
         req.status = status
         req.end_reason = reason
-        self.sink.record({
+        rec = {
             "event": "request_end", "rid": req.rid,
             "status": status.value, "reason": reason,
             "generated": len(req.out_tokens),
             "preemptions": req.preemptions,
             "restarts": req.restarts,
-        })
+        }
+        # health-plane enrichment, mirroring the engine's: latency from
+        # stamps already taken, SLO verdict from static budgets —
+        # zero new clock reads
+        if req.t_arrival is not None:
+            t_end = req.t_done if req.t_done is not None else now
+            if req.t_first_token is not None:
+                rec["ttft_ms"] = round(
+                    1e3 * (req.t_first_token - req.t_arrival), 6)
+            if t_end is not None:
+                rec["latency_ms"] = round(
+                    1e3 * (t_end - req.t_arrival), 6)
+        rec["slo_ok"] = ServingEngine._within_budget(req)
+        if req.labels:
+            rec["labels"] = dict(req.labels)
+        self.sink.record(rec)
         if self.tracer is not None:
             t = now if now is not None else getattr(
                 req, "_t_attr", req.t_arrival)
@@ -533,6 +572,47 @@ class ReplicaFleet:
                     swapped=[r.idx for r in self.replicas
                              if r.swaps > 0],
                     missed=sorted(self._missed_swaps))
+
+    def abort_rolling_update(self) -> int:
+        """Cancel an in-flight rolling update mid-wave — the health
+        plane's fast-burn actuator (a fleet on fire must stop churning
+        capacity through drain cycles). The replica currently draining
+        for the wave rejoins on its OLD weights once idle (a normal
+        :meth:`try_join` with no params — the plan's swap is dropped,
+        not remembered), queued replicas never drain, and missed-swap
+        entries this plan created are discarded so a later restart does
+        not resurrect the aborted weights. Returns the number of live
+        replicas the wave had NOT yet swapped. No-op (returns 0) when
+        no update is scheduled."""
+        plan = self._swap_plan
+        if plan is None:
+            return 0
+        remaining = len(plan["queue"])
+        cur = plan["current"]
+        if cur is not None:
+            remaining += 1
+            rep = self.replicas[cur]
+            if rep.state is ReplicaState.DRAINING:
+                # rejoin on old weights, now if idle or via the caller's
+                # next try_join; either way the swap is cancelled
+                self._missed_swaps.pop(cur, None)
+                if rep.engine.scheduler.idle:
+                    self.try_join(cur)
+        self._swap_plan = None
+        # drop the missed-swap IOUs this plan wrote for dead/draining
+        # replicas — identity is the plan's params object
+        for idx in [i for i, p in self._missed_swaps.items()
+                    if p is plan["params"]]:
+            del self._missed_swaps[idx]
+        self.sink.record({"event": "rolling_update_aborted",
+                          "remaining": remaining,
+                          "current": cur})
+        if self.tracer is not None:
+            self.tracer.emit(
+                "rolling_update_aborted", "fleet-lifecycle",
+                self._t_last, self._t_last,
+                remaining=remaining, current=cur)
+        return remaining
 
     # -- replica failure + migration ---------------------------------------
     def _on_replica_death(self, rep: Replica, err: BaseException,
@@ -748,6 +828,11 @@ class ReplicaFleet:
             except (ChaosError, HangError) as e:
                 self._on_replica_death(rep, e, step)
         self.steps_run += 1
+        if self.health is not None:
+            # evaluate SLOs/alerts at the clock value this boundary
+            # already read (_place_migrants / death handling refreshed
+            # _t_last) — the health plane adds zero clock reads
+            self.health.on_boundary(self._t_last, step=self.steps_run)
 
     def generate(self, requests: Sequence[Request] = (),
                  max_steps: Optional[int] = None

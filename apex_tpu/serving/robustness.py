@@ -234,6 +234,21 @@ class AdmissionController:
         else:
             self._est_step_s = (1 - a) * self._est_step_s + a * float(dt_s)
 
+    # -- alert-driven degradation (telemetry.alerts.FleetResponder) ----------
+    def arm_degradation(
+            self, policy: Optional[DegradationPolicy]) -> None:
+        """Install (or tighten) the degradation policy at runtime — the
+        health plane's load-shedding actuator. Policies are frozen
+        dataclasses, so swapping the reference is the whole mechanism;
+        the next :meth:`check` call sees the new shed/cap thresholds."""
+        self.degradation = policy
+
+    def relax_degradation(
+            self, policy: Optional[DegradationPolicy] = None) -> None:
+        """Restore a previously saved policy (``None`` = fully disarm)
+        when the driving alert resolves."""
+        self.degradation = policy
+
     # -- feasibility ---------------------------------------------------------
     def latency_bounds_ms(self, prompt_len: int, max_new: int,
                           queued_tokens: int):

@@ -119,6 +119,11 @@ class Request:
     trace: Optional[object] = None
     attr: Optional[dict] = None
     attr_ttft: Optional[dict] = None
+    # generic label dict for the fleet health plane's aggregation layer
+    # (telemetry.timeseries — the multi-tenant hook): carried onto this
+    # request's request_end record, where it merges with (and wins
+    # over) any stream-level TaggedRecorder labels
+    labels: Optional[dict] = None
 
     @property
     def done(self) -> bool:

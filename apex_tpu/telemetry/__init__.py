@@ -21,6 +21,14 @@ Four parts, designed so instrumentation costs nothing on the hot path:
   records (deterministic under ``VirtualClock``), the exact-sum
   latency-attribution ledger, and the bounded flight-recorder ring
   dumped as a black box on hangs/crashes;
+- :mod:`~apex_tpu.telemetry.timeseries` / :mod:`~apex_tpu.telemetry.slo`
+  / :mod:`~apex_tpu.telemetry.alerts` — the fleet health plane:
+  bounded-memory labeled aggregation over the recorder stream
+  (counters/gauges + log-bucket histograms with exact deterministic
+  merges), SLO error budgets with multi-window multi-burn-rate alert
+  evaluation, and the :class:`AlertManager` that routes firing alerts
+  to the fleet's proven actuators (degradation, replica restart,
+  rolling-update abort, supervisor escalation);
 - :mod:`~apex_tpu.telemetry.numerics` — the numerics health monitor:
   per-tensor overflow provenance (pytree and packed flat-buffer paths),
   opt-in activation-watch taps, and an anomaly-rule engine
@@ -63,6 +71,19 @@ from .recorder import (  # noqa: F401
     read_jsonl,
     stamp_wall,
 )
+from .alerts import (  # noqa: F401
+    AlertManager,
+    EscalationResponder,
+    FleetResponder,
+    HealthMonitor,
+)
+from .slo import (  # noqa: F401
+    SLO,
+    AlertState,
+    ErrorBudget,
+    SLOTracker,
+    default_serving_slos,
+)
 from .spans import (  # noqa: F401
     ATTR_TERMS,
     TraceContext,
@@ -72,6 +93,13 @@ from .spans import (  # noqa: F401
     attr_snapshot_ttft,
     attribution_summary,
     dominant_cause,
+)
+from .timeseries import (  # noqa: F401
+    BASE_LABELS,
+    LogBucketHistogram,
+    MetricsAggregator,
+    format_labels,
+    label_key,
 )
 from .tracing import (  # noqa: F401
     TraceSession,
@@ -97,6 +125,12 @@ __all__ = [
     "percentiles", "read_jsonl", "stamp_wall",
     "ATTR_TERMS", "TraceContext", "Tracer", "attr_account", "attr_init",
     "attr_snapshot_ttft", "attribution_summary", "dominant_cause",
+    "BASE_LABELS", "LogBucketHistogram", "MetricsAggregator",
+    "format_labels", "label_key",
+    "SLO", "AlertState", "ErrorBudget", "SLOTracker",
+    "default_serving_slos",
+    "AlertManager", "EscalationResponder", "FleetResponder",
+    "HealthMonitor",
     "TraceSession", "aggregate_op_times", "breakdown_table",
     "categorize_op", "cost_analysis_breakdown", "parse_xspace_op_times",
     "profile_step", "short_op_name", "trace_session",

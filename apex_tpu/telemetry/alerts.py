@@ -1,0 +1,293 @@
+"""Alert routing and auto-response: the closed half of the
+monitor→alert→respond loop.
+
+:class:`AlertManager` evaluates every :class:`~.slo.SLOTracker` at the
+fleet's scheduling boundaries (clock values the fleet already read —
+zero new reads, deterministic under ``VirtualClock``), records every
+state transition as a structured ``alert`` event through the shared
+recorder sink, and routes firing alerts to **responders** — the
+actuators the repo already proved, now driven automatically:
+
+- :class:`FleetResponder` (serving side, bound to a
+  :class:`~apex_tpu.serving.fleet.ReplicaFleet`):
+
+  * **arm degradation** — a firing serving SLO installs a tighter
+    :class:`~apex_tpu.serving.robustness.DegradationPolicy` on every
+    live replica's admission controller (PR-10's shed/cap machinery,
+    no longer manually armed); the original policies are remembered
+    and **relaxed** back when the alert resolves.
+  * **restart dead replicas** — a firing availability alert restarts
+    every DEAD replica through
+    :meth:`~apex_tpu.serving.fleet.ReplicaFleet.restart_replica`
+    (missed weight swaps still applied, per PR-11's contract).
+  * **abort a rolling update mid-wave** — a page-severity (fast-burn)
+    alert while a :meth:`schedule_rolling_update` wave is in flight
+    calls :meth:`~apex_tpu.serving.fleet.ReplicaFleet.
+    abort_rolling_update`: the half-updated fleet stops churning
+    capacity while it is on fire.
+
+- :class:`EscalationResponder` (training side): forwards page-severity
+  alerts to a supplied callback — the elastic service's supervisor
+  restart/rewind hook (``Supervisor`` owns the actual restart; this
+  responder is the policy wire into it).
+
+Every action lands as a ``response`` event (alert name, action, target,
+the boundary's clock value) in the same stream the spans ride, so a
+trace waterfall shows WHY the fleet degraded/restarted/aborted and
+which alert episode caused it. ``fleet_status.py`` renders both.
+
+:class:`HealthMonitor` bundles aggregator + manager for the
+``ReplicaFleet(health=...)`` hook: the fleet fans its sink into the
+aggregator and calls :meth:`HealthMonitor.on_boundary` once per
+scheduling boundary with its already-read clock value.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .recorder import NullRecorder
+from .slo import AlertState, SLOTracker, default_serving_slos
+from .timeseries import MetricsAggregator
+
+
+class AlertManager:
+    """Evaluate trackers, record transitions, drive responders.
+
+    ``sink`` is any recorder; transitions emit ``{"event": "alert",
+    ...}`` and responder actions ``{"event": "response", ...}`` —
+    both also fed back into the aggregator by the
+    :class:`HealthMonitor` fan-in, so alert/response counts are
+    themselves fleet metrics.
+    """
+
+    def __init__(self, trackers: Sequence[SLOTracker], *,
+                 sink=None, responders: Sequence = ()):
+        self.trackers = list(trackers)
+        self.sink = sink if sink is not None else NullRecorder()
+        self.responders = list(responders)
+        self.evaluations = 0
+        self.last_eval: Dict[str, dict] = {}
+
+    def tracker(self, name: str) -> Optional[SLOTracker]:
+        for t in self.trackers:
+            if t.slo.name == name:
+                return t
+        return None
+
+    @property
+    def firing(self) -> List[SLOTracker]:
+        return [t for t in self.trackers if t.firing]
+
+    def evaluate(self, agg: MetricsAggregator, now: float,
+                 *, step: Optional[int] = None) -> List[dict]:
+        """One evaluation pass at the caller's clock value. Returns the
+        per-tracker evaluation records; transitions were recorded and
+        responders driven as a side effect."""
+        self.evaluations += 1
+        out = []
+        for t in self.trackers:
+            src = t.source
+            if hasattr(src, "now"):   # rate sources need the eval clock
+                src.now = now
+            rec = t.evaluate(agg, now)
+            if step is not None:
+                rec["step"] = int(step)
+            self.last_eval[t.slo.name] = rec
+            out.append(rec)
+            transitioned = rec["state"] != rec["prev_state"]
+            if transitioned:
+                self.sink.record({"event": "alert", **rec})
+            for responder in self.responders:
+                for action in (responder.respond(t, rec, now) or ()):
+                    body = {"event": "response", "alert": t.slo.name,
+                            "t": float(now), **action}
+                    if step is not None:
+                        body["step"] = int(step)
+                    self.sink.record(body)
+        return out
+
+
+class FleetResponder:
+    """Route serving-side alerts to a :class:`ReplicaFleet`'s proven
+    actuators. Stateless toward the fleet except for the remembered
+    pre-degradation policies (so relax restores exactly what the
+    operator configured, not a guess)."""
+
+    #: alerts that indicate load-shaped trouble → degradation
+    LOAD_ALERTS = ("slo_attainment", "ttft_p99", "goodput_floor")
+
+    def __init__(self, fleet, *,
+                 degradation=None,
+                 restart_dead: bool = True,
+                 abort_updates: bool = True):
+        from ..serving.robustness import DegradationPolicy
+
+        self.fleet = fleet
+        self.degradation = (degradation if degradation is not None
+                            else DegradationPolicy(shed_after=1,
+                                                   cap_max_new=32))
+        self.restart_dead = restart_dead
+        self.abort_updates = abort_updates
+        self._saved_policies: Dict[int, object] = {}
+        self.armed = False
+        self.actions: List[dict] = []
+
+    def _emit(self, action: str, **detail) -> dict:
+        body = {"action": action, **detail}
+        self.actions.append(body)
+        return body
+
+    def respond(self, tracker: SLOTracker, rec: dict,
+                now: float) -> List[dict]:
+        out: List[dict] = []
+        name = tracker.slo.name
+        state = rec["state"]
+        firing = state == AlertState.FIRING.value
+        newly_firing = firing and rec["prev_state"] != state
+        # -- degradation arm/relax (load-shaped alerts) -------------------
+        if name in self.LOAD_ALERTS:
+            if firing and not self.armed:
+                out.extend(self._arm_degradation())
+            elif state == AlertState.RESOLVED.value and self.armed:
+                # another load alert still firing re-arms at its own
+                # next evaluation (armed flips False here) — relax is
+                # safe to run eagerly, convergence is one boundary away
+                out.extend(self._relax_degradation())
+        # -- abort a rolling update mid-wave on fast burn -----------------
+        if (self.abort_updates and newly_firing
+                and rec.get("severity") == tracker.slo.severity_fast
+                and self.fleet._swap_plan is not None):
+            aborted = self.fleet.abort_rolling_update()
+            out.append(self._emit("abort_rolling_update",
+                                  remaining=aborted))
+        # -- restart dead replicas on availability pages ------------------
+        if (self.restart_dead and firing
+                and name == "replica_available"):
+            for rep in self.fleet.replicas:
+                if not rep.live:
+                    self.fleet.restart_replica(rep.idx)
+                    out.append(self._emit("restart_replica",
+                                          replica_id=rep.idx))
+        return out
+
+    def _arm_degradation(self) -> List[dict]:
+        out = []
+        for rep in self.fleet.replicas:
+            ctl = rep.engine.admission
+            if rep.live and ctl is not None:
+                self._saved_policies[rep.idx] = ctl.degradation
+                ctl.arm_degradation(self.degradation)
+                out.append(self._emit("arm_degradation",
+                                      replica_id=rep.idx,
+                                      shed_after=self.degradation
+                                      .shed_after,
+                                      cap_max_new=self.degradation
+                                      .cap_max_new))
+        self.armed = True
+        return out
+
+    def _relax_degradation(self) -> List[dict]:
+        out = []
+        for rep in self.fleet.replicas:
+            ctl = rep.engine.admission
+            if ctl is not None and rep.idx in self._saved_policies:
+                ctl.relax_degradation(self._saved_policies.pop(rep.idx))
+                out.append(self._emit("relax_degradation",
+                                      replica_id=rep.idx))
+        self._saved_policies.clear()
+        self.armed = False
+        return out
+
+
+class EscalationResponder:
+    """Forward page-severity alerts to an escalation callback — the
+    training-side hook (the elastic :class:`~apex_tpu.resilience.
+    elastic.Supervisor` restart/rewind path, an operator pager, ...).
+    ``on_escalate(slo_name, rec)`` is called once per newly-firing
+    page; what it does (kill the world, rewind the data iterator) is
+    the callee's business."""
+
+    def __init__(self, on_escalate: Callable[[str, dict], None], *,
+                 alerts: Optional[Sequence[str]] = None):
+        self.on_escalate = on_escalate
+        self.alerts = tuple(alerts) if alerts is not None else None
+        self.escalations = 0
+
+    def respond(self, tracker: SLOTracker, rec: dict,
+                now: float) -> List[dict]:
+        name = tracker.slo.name
+        if self.alerts is not None and name not in self.alerts:
+            return []
+        newly_firing = (rec["state"] == AlertState.FIRING.value
+                        and rec["prev_state"] != rec["state"])
+        if not newly_firing or rec.get("severity") != tracker.slo.severity_fast:
+            return []
+        self.escalations += 1
+        self.on_escalate(name, dict(rec))
+        return [{"action": "escalate", "target": name}]
+
+
+class HealthMonitor:
+    """Aggregator + SLO trackers + alert manager, bundled for the
+    ``ReplicaFleet(health=...)`` hook.
+
+    The fleet fans its record stream into :attr:`aggregator` (via
+    ``MultiRecorder`` — the user's sink still sees everything) and
+    calls :meth:`on_boundary` once per scheduling boundary with the
+    clock value it already read; nothing here reads clocks or touches
+    devices. ``attach_fleet`` wires the default
+    :class:`FleetResponder`; pass ``responders=`` for custom routing.
+    """
+
+    def __init__(self, *, slos: Optional[Sequence[SLOTracker]] = None,
+                 aggregator: Optional[MetricsAggregator] = None,
+                 responders: Sequence = (), sink=None, **slo_kw):
+        self.aggregator = (aggregator if aggregator is not None
+                           else MetricsAggregator())
+        trackers = (list(slos) if slos is not None
+                    else default_serving_slos(**slo_kw))
+        self.manager = AlertManager(trackers, sink=sink,
+                                    responders=list(responders))
+        self.fleet_responder: Optional[FleetResponder] = None
+
+    def attach_fleet(self, fleet, *, sink=None, **responder_kw) -> None:
+        """Bind the default fleet actuators (idempotent per fleet) and
+        point alert/response events at the fleet's sink so they land in
+        the same attributable stream as everything else."""
+        if sink is not None:
+            self.manager.sink = sink
+        self.fleet_responder = FleetResponder(fleet, **responder_kw)
+        self.manager.responders.append(self.fleet_responder)
+
+    def on_boundary(self, now: float,
+                    *, step: Optional[int] = None) -> List[dict]:
+        """One health evaluation at a fleet scheduling boundary;
+        ``now`` is the fleet's already-read clock value."""
+        recs = self.manager.evaluate(self.aggregator, now, step=step)
+        # alert/response events were recorded through the manager's
+        # sink; when that sink is the fleet's fan-in they also reached
+        # the aggregator, making alert counts metrics like any other
+        return recs
+
+    @property
+    def firing(self) -> List[str]:
+        return [t.slo.name for t in self.manager.firing]
+
+    def snapshot(self) -> dict:
+        """Aggregates + per-SLO budget/state, deterministic ordering."""
+        return {
+            "metrics": self.aggregator.snapshot(),
+            "slos": {
+                t.slo.name: {
+                    "state": t.state.value,
+                    "objective": t.slo.objective,
+                    "budget_remaining": round(t.budget.remaining, 4),
+                    "attainment": (round(t.budget.attainment, 4)
+                                   if t.budget.attainment is not None
+                                   else None),
+                    "fired": t.fired_count,
+                    "resolved": t.resolved_count,
+                }
+                for t in sorted(self.manager.trackers,
+                                key=lambda t: t.slo.name)},
+        }

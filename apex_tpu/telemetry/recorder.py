@@ -206,15 +206,32 @@ class TaggedRecorder(NullRecorder):
     ``close()`` only flushes. A tagger that wraps a sink nobody else
     holds (e.g. a fake host's private JSONL) passes ``owns_sink=True``
     to get the close cascade back.
+
+    ``labels`` is the generic label dict for the fleet health plane's
+    aggregation layer (``telemetry.timeseries`` — the multi-tenant
+    hook): unlike ``tags`` (merged at the record's top level), labels
+    merge into each record's ``labels`` dict, where the record's own
+    label keys win on collision (a per-request tenant overrides the
+    stream-level one).
     """
 
     def __init__(self, sink, tags: Optional[dict] = None, *,
-                 owns_sink: bool = False, **tag_kw):
+                 owns_sink: bool = False,
+                 labels: Optional[dict] = None, **tag_kw):
         self.sink = sink
         self.owns_sink = owns_sink
         self.tags = {**(tags or {}), **tag_kw}
+        self.labels = dict(labels) if labels else None
 
     def record(self, rec: dict) -> None:
+        if self.labels is not None:
+            rec_labels = rec.get("labels")
+            rec = {**self.tags, **rec,
+                   "labels": {**self.labels,
+                              **(rec_labels if isinstance(rec_labels,
+                                                          dict) else {})}}
+            self.sink.record(rec)
+            return
         self.sink.record({**self.tags, **rec})
 
     def add_scalar(self, name, value, step) -> None:

@@ -1,0 +1,405 @@
+"""Declarative SLOs, error budgets, and multi-window burn-rate alerts.
+
+The Google-SRE error-budget machinery over the
+:class:`~.timeseries.MetricsAggregator` stream: an :class:`SLO` names
+an objective ("99% of offered requests complete within their budgets"),
+its error budget is the allowed bad fraction (``1 - objective``), and
+the **burn rate** is how fast the fleet is spending that budget
+(``bad_fraction / budget``; burn 1.0 = exactly on budget). Alerting is
+multi-window, multi-burn-rate: a *fast* window at a high burn threshold
+pages on sudden collapse within minutes of serving time, a *slow*
+window at a low threshold catches sustained erosion that never spikes —
+both windows must agree before an alert fires (the standard
+false-positive guard), and the tracker's state machine then walks
+``ok → pending → firing → resolved`` with hysteresis (``clear_after``
+consecutive clean evaluations below ``resolve_frac`` of the threshold)
+so one episode fires exactly once and cannot flap across the boundary.
+
+Determinism: the tracker never reads a clock. :meth:`SLOTracker.
+evaluate` is called at fleet scheduling boundaries with the clock value
+the fleet already read (``ReplicaFleet._t_last``), and every window is
+denominated in those values — under
+:class:`~apex_tpu.serving.robustness.VirtualClock` two runs of the same
+trace produce byte-identical alert timelines. Windows default to
+serving timescales (seconds of engine stepping, not the SRE book's
+hours) and scale linearly if you change them.
+
+Objectives shipped by :func:`default_serving_slos`:
+
+===================  ======================================  =========
+name                 source                                   kind
+===================  ======================================  =========
+slo_attainment       ``slo_good_total`` / ``slo_bad_total``  ratio
+ttft_p99             ``ttft_ms`` sketch p99 vs target         threshold
+goodput_floor        ``goodput_tokens_total`` rate vs floor   threshold
+replica_available    ``replica_up`` gauges vs min fraction    threshold
+ckpt_commit_p99      ``checkpoint_commit_s`` p99 vs target    threshold
+===================  ======================================  =========
+
+Ratio SLOs consume counter *deltas* between evaluations (each request's
+outcome is one budget event); threshold SLOs contribute one good/bad
+sample per evaluation (the value was in/out of spec at that boundary) —
+one state machine serves both. See docs/observability.md.
+"""
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, List, Optional, Tuple
+
+from .timeseries import MetricsAggregator
+
+
+class AlertState(enum.Enum):
+    OK = "ok"
+    PENDING = "pending"    # burn over threshold, not yet for_count evals
+    FIRING = "firing"
+    RESOLVED = "resolved"  # transient: one evaluation, then OK
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One objective + its alerting policy.
+
+    - ``objective``: target good fraction in [0, 1) — the error budget
+      is ``1 - objective``.
+    - ``kind``: ``"ratio"`` (good/bad counter deltas) or
+      ``"threshold"`` (a value checked against ``target`` each
+      evaluation; ``higher_is_better`` orients it).
+    - ``fast_window_s`` / ``fast_burn``: the page pair — short window,
+      high burn (collapse now).
+    - ``slow_window_s`` / ``slow_burn``: the ticket pair — long window,
+      low burn (sustained erosion). An alert fires when EITHER pair
+      trips, and a pair trips only when both its window and the other
+      window confirm at its threshold (multi-window confirmation: the
+      fast page also checks the slow window at ``fast_burn`` scaled by
+      ``confirm_frac``, so a single-boundary blip cannot page).
+    - ``for_count``: consecutive tripped evaluations before PENDING
+      promotes to FIRING (0 = immediately).
+    - ``clear_after`` / ``resolve_frac``: hysteresis down — FIRING
+      resolves only after ``clear_after`` consecutive evaluations with
+      every burn below ``resolve_frac * threshold``.
+    """
+
+    name: str
+    objective: float = 0.99
+    kind: str = "ratio"
+    target: Optional[float] = None
+    higher_is_better: bool = False
+    fast_window_s: float = 30.0
+    fast_burn: float = 8.0
+    slow_window_s: float = 120.0
+    slow_burn: float = 2.0
+    confirm_frac: float = 0.25
+    for_count: int = 1
+    clear_after: int = 3
+    resolve_frac: float = 0.5
+    severity_fast: str = "page"
+    severity_slow: str = "ticket"
+
+    def __post_init__(self):
+        if not 0.0 <= self.objective < 1.0:
+            raise ValueError(
+                f"objective must be in [0, 1), got {self.objective}")
+        if self.kind not in ("ratio", "threshold"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if self.kind == "threshold" and self.target is None:
+            raise ValueError(
+                f"threshold SLO {self.name!r} needs target=")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+
+@dataclass
+class _WindowSample:
+    t: float
+    good: float
+    bad: float
+
+
+class ErrorBudget:
+    """Cumulative budget accounting: of everything offered so far, how
+    much of the allowed bad fraction is spent. ``remaining`` < 0 means
+    the objective is already missed over the whole run."""
+
+    def __init__(self, slo: SLO):
+        self.slo = slo
+        self.good = 0.0
+        self.bad = 0.0
+
+    def observe(self, good: float, bad: float) -> None:
+        self.good += good
+        self.bad += bad
+
+    @property
+    def total(self) -> float:
+        return self.good + self.bad
+
+    @property
+    def bad_fraction(self) -> float:
+        return self.bad / self.total if self.total > 0 else 0.0
+
+    @property
+    def consumed(self) -> float:
+        """Fraction of the error budget spent (1.0 = exactly at the
+        objective boundary)."""
+        b = self.slo.budget
+        return self.bad_fraction / b if b > 0 else 0.0
+
+    @property
+    def remaining(self) -> float:
+        return 1.0 - self.consumed
+
+    @property
+    def attainment(self) -> Optional[float]:
+        return (self.good / self.total) if self.total > 0 else None
+
+
+class SLOTracker:
+    """Windowed burn-rate evaluation + the alert state machine for one
+    :class:`SLO`.
+
+    ``source`` maps the aggregator to this SLO's signal:
+    ``source(agg)`` returns ``(good_total, bad_total)`` for ratio SLOs
+    (monotonic totals — the tracker differences them) or a float value
+    (or None = no data) for threshold SLOs. Evaluation mutates nothing
+    outside the tracker and reads no clocks — ``now`` is always the
+    caller's already-read value.
+    """
+
+    def __init__(self, slo: SLO,
+                 source: Callable[[MetricsAggregator], object]):
+        self.slo = slo
+        self.source = source
+        self.budget = ErrorBudget(slo)
+        self.state = AlertState.OK
+        self.samples: Deque[_WindowSample] = deque()
+        self._last_good = 0.0
+        self._last_bad = 0.0
+        self._trip_run = 0
+        self._clean_run = 0
+        self.fired_count = 0
+        self.resolved_count = 0
+        self.timeline: List[dict] = []
+
+    # -- signal extraction -------------------------------------------------
+    def _sample(self, agg: MetricsAggregator, now: float
+                ) -> Tuple[float, float, Optional[float]]:
+        """(good_delta, bad_delta, value) for this evaluation."""
+        sig = self.source(agg)
+        if self.slo.kind == "ratio":
+            good_t, bad_t = sig  # type: ignore[misc]
+            dg = max(0.0, float(good_t) - self._last_good)
+            db = max(0.0, float(bad_t) - self._last_bad)
+            self._last_good, self._last_bad = float(good_t), float(bad_t)
+            return dg, db, None
+        if sig is None:
+            return 0.0, 0.0, None  # no data: contributes nothing
+        v = float(sig)  # type: ignore[arg-type]
+        ok = (v >= self.slo.target if self.slo.higher_is_better
+              else v <= self.slo.target)
+        return (1.0, 0.0, v) if ok else (0.0, 1.0, v)
+
+    def _window(self, now: float, horizon_s: float
+                ) -> Tuple[float, float]:
+        good = bad = 0.0
+        for s in self.samples:
+            if s.t > now - horizon_s:
+                good += s.good
+                bad += s.bad
+        return good, bad
+
+    def burn_rate(self, now: float, horizon_s: float) -> float:
+        """bad fraction over the window divided by the error budget —
+        1.0 spends the budget exactly at the objective's rate."""
+        good, bad = self._window(now, horizon_s)
+        total = good + bad
+        if total <= 0:
+            return 0.0
+        return (bad / total) / self.slo.budget
+
+    # -- the state machine -------------------------------------------------
+    def evaluate(self, agg: MetricsAggregator, now: float) -> dict:
+        """One evaluation at the caller's clock value: ingest this
+        boundary's signal, compute both windows' burn rates, advance
+        the state machine. Returns the evaluation record (the
+        ``alert`` event body on transitions)."""
+        slo = self.slo
+        dg, db, value = self._sample(agg, now)
+        if dg or db:
+            self.samples.append(_WindowSample(now, dg, db))
+            self.budget.observe(dg, db)
+        # bounded memory: nothing older than the slow window matters
+        horizon = now - max(slo.slow_window_s, slo.fast_window_s)
+        while self.samples and self.samples[0].t <= horizon:
+            self.samples.popleft()
+
+        fast = self.burn_rate(now, slo.fast_window_s)
+        slow = self.burn_rate(now, slo.slow_window_s)
+        # multi-window confirmation: each pair needs the OTHER window
+        # burning too (at confirm_frac of its threshold) — a stale
+        # spike that already drained out of the fast window cannot
+        # keep a page alive, and one bad boundary cannot start one
+        page = (fast >= slo.fast_burn
+                and slow >= slo.fast_burn * slo.confirm_frac)
+        ticket = (slow >= slo.slow_burn
+                  and fast >= slo.slow_burn * slo.confirm_frac)
+        tripped = page or ticket
+        severity = (slo.severity_fast if page else
+                    slo.severity_slow if ticket else None)
+
+        prev = self.state
+        if self.state in (AlertState.OK, AlertState.RESOLVED):
+            self.state = AlertState.OK
+            self._clean_run = 0
+            if tripped:
+                self._trip_run = 1
+                self.state = (AlertState.FIRING
+                              if slo.for_count <= 1 else
+                              AlertState.PENDING)
+            else:
+                self._trip_run = 0
+        elif self.state is AlertState.PENDING:
+            if tripped:
+                self._trip_run += 1
+                if self._trip_run >= slo.for_count:
+                    self.state = AlertState.FIRING
+            else:
+                self._trip_run = 0
+                self.state = AlertState.OK
+        elif self.state is AlertState.FIRING:
+            clean = (fast < slo.fast_burn * slo.resolve_frac
+                     and slow < slo.slow_burn * slo.resolve_frac)
+            if clean:
+                self._clean_run += 1
+                if self._clean_run >= slo.clear_after:
+                    self.state = AlertState.RESOLVED
+                    self._clean_run = 0
+            else:
+                self._clean_run = 0
+        if self.state is AlertState.FIRING and prev is not AlertState.FIRING:
+            self.fired_count += 1
+        if self.state is AlertState.RESOLVED:
+            self.resolved_count += 1
+
+        rec = {
+            "name": slo.name,
+            "state": self.state.value,
+            "prev_state": prev.value,
+            "severity": severity,
+            "burn_fast": round(fast, 4),
+            "burn_slow": round(slow, 4),
+            "budget_remaining": round(self.budget.remaining, 4),
+            "attainment": (round(self.budget.attainment, 4)
+                           if self.budget.attainment is not None
+                           else None),
+            "t": float(now),
+        }
+        if value is not None:
+            rec["value"] = round(value, 4)
+        if self.state is not prev:
+            self.timeline.append(dict(rec))
+        return rec
+
+    @property
+    def firing(self) -> bool:
+        return self.state is AlertState.FIRING
+
+
+# ---------------------------------------------------------------------------
+# the shipped objective set
+
+def _ratio_attainment(agg: MetricsAggregator):
+    return (agg.counter_total("slo_good_total"),
+            agg.counter_total("slo_bad_total"))
+
+
+def _ttft_p99(agg: MetricsAggregator):
+    h = agg.hist_merged("ttft_ms")
+    return h.quantile(0.99) if h is not None else None
+
+
+def _commit_p99(agg: MetricsAggregator):
+    h = agg.hist_merged("checkpoint_commit_s")
+    return h.quantile(0.99) if h is not None else None
+
+
+def _replica_availability(agg: MetricsAggregator):
+    ups = agg.gauge_values("replica_up")
+    if not ups:
+        return None
+    return sum(1.0 for v in ups.values() if v > 0) / len(ups)
+
+
+class _GoodputRate:
+    """tokens/sec of in-SLO completions between evaluations, from the
+    counter delta over the caller-provided clock deltas (no clock
+    reads of its own)."""
+
+    def __init__(self):
+        self._last_tokens = 0.0
+        self._last_t: Optional[float] = None
+
+    def __call__(self, agg: MetricsAggregator, now: float
+                 ) -> Optional[float]:
+        tok = agg.counter_total("goodput_tokens_total")
+        if self._last_t is None or now <= self._last_t:
+            self._last_tokens, self._last_t = tok, now
+            return None
+        rate = (tok - self._last_tokens) / (now - self._last_t)
+        self._last_tokens, self._last_t = tok, now
+        return rate
+
+
+class _TimedSource:
+    """Adapt a (agg, now)-source to the tracker's (agg)-source by
+    closing over the evaluation clock value the manager passes in."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.now = 0.0
+
+    def __call__(self, agg: MetricsAggregator):
+        return self.fn(agg, self.now)
+
+
+def default_serving_slos(
+    *,
+    attainment_objective: float = 0.9,
+    ttft_p99_ms: Optional[float] = None,
+    goodput_floor_tps: Optional[float] = None,
+    availability_min: float = 0.99,
+    commit_p99_s: Optional[float] = None,
+    fast_window_s: float = 30.0,
+    slow_window_s: float = 120.0,
+) -> List[SLOTracker]:
+    """The shipped objective set, scaled to serving timescales. TTFT /
+    goodput / commit objectives are opt-in (pass their targets); the
+    attainment ratio and replica availability are always on."""
+    mk = dict(fast_window_s=fast_window_s, slow_window_s=slow_window_s)
+    out = [
+        SLOTracker(SLO(name="slo_attainment",
+                       objective=attainment_objective,
+                       kind="ratio", **mk), _ratio_attainment),
+        SLOTracker(SLO(name="replica_available", objective=0.5,
+                       kind="threshold", target=availability_min,
+                       higher_is_better=True, **mk),
+                   _replica_availability),
+    ]
+    if ttft_p99_ms is not None:
+        out.append(SLOTracker(
+            SLO(name="ttft_p99", objective=0.9, kind="threshold",
+                target=float(ttft_p99_ms), **mk), _ttft_p99))
+    if goodput_floor_tps is not None:
+        out.append(SLOTracker(
+            SLO(name="goodput_floor", objective=0.9, kind="threshold",
+                target=float(goodput_floor_tps), higher_is_better=True,
+                **mk), _TimedSource(_GoodputRate())))
+    if commit_p99_s is not None:
+        out.append(SLOTracker(
+            SLO(name="ckpt_commit_p99", objective=0.9, kind="threshold",
+                target=float(commit_p99_s), **mk), _commit_p99))
+    return out

@@ -1,0 +1,473 @@
+"""Streaming fleet metrics: bounded-memory labeled aggregation over the
+recorder fan-out.
+
+The serving fleet and the elastic training service already emit a
+structured event stream through the PR-2 recorder stack (``request_end``
+/ ``serving_step`` heartbeats / ``replica_down`` / ``checkpoint_commit``
+/ span records, all replica-tagged by :class:`~.recorder.TaggedRecorder`).
+This module folds that stream into live fleet-level aggregates — the
+input side of the monitor→alert→respond loop (:mod:`~.slo`,
+:mod:`~.alerts`):
+
+- :class:`MetricsAggregator` — a recorder-protocol sink (drop it into a
+  :class:`~.recorder.MultiRecorder` next to the JSONL stream, or hand it
+  to ``ReplicaFleet(health=...)``) that routes every record by its
+  ``event`` into **counters** (monotonic totals: requests by status,
+  rejects by code, sheds, migrations, replica deaths), **gauges** (last
+  value wins: queue depth, occupancy, free pages, replica liveness) and
+  **histograms** (:class:`LogBucketHistogram`: TTFT, request latency,
+  checkpoint save/commit latency). Aggregation is a pure function of
+  the records — the aggregator reads **no clocks** and forces **no host
+  syncs** (it only ever sees what the hot paths already emitted), so
+  runs under :class:`~apex_tpu.serving.robustness.VirtualClock` produce
+  byte-identical snapshots and the PR-4 auditor's step programs are
+  untouched by construction.
+- :class:`LogBucketHistogram` — a DDSketch-style log-bucketed streaming
+  histogram: bounded memory at a documented relative quantile error
+  (``alpha``, default 5%), with **exact deterministic merges** (bucket
+  counts add; ``merge(a, b) == merge(b, a)`` byte-identically), so
+  per-replica sketches fold into fleet sketches without re-streaming.
+
+Labels: every series carries the attribution labels already riding the
+records — ``replica_id`` / ``tp`` / ``host`` — plus the generic
+``labels`` dict a record may carry (the multi-tenant hook:
+``TaggedRecorder(labels=...)`` stamps a tenant on every record of a
+stream, ``Request(labels=...)`` stamps one request's terminal record;
+record keys win on collision). Label sets are sorted into the series
+key, so snapshot/exposition order is deterministic. Memory stays
+bounded by ``max_series`` per metric family — overflow series are
+counted (``dropped_series``), never silently folded.
+
+See docs/observability.md "Fleet health & SLOs".
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from .recorder import NullRecorder
+
+#: labels lifted from a record's top level into every series it feeds
+#: (the TaggedRecorder attribution keys the fleet already stamps)
+BASE_LABELS = ("replica_id", "tp", "host")
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def label_key(rec: dict, extra: Optional[dict] = None) -> LabelKey:
+    """The deterministic series key for a record: the
+    :data:`BASE_LABELS` present on the record plus its generic
+    ``labels`` dict (and ``extra``), sorted by label name. Record-level
+    ``labels`` win over lifted base labels of the same name."""
+    out: Dict[str, str] = {}
+    for k in BASE_LABELS:
+        v = rec.get(k)
+        if v is not None:
+            out[k] = str(v)
+    lab = rec.get("labels")
+    if isinstance(lab, dict):
+        for k, v in lab.items():
+            out[str(k)] = str(v)
+    if extra:
+        for k, v in extra.items():
+            out[str(k)] = str(v)
+    return tuple(sorted(out.items()))
+
+
+def format_labels(key: LabelKey) -> str:
+    """Prometheus-style ``{k="v",...}`` (empty string for no labels)."""
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class LogBucketHistogram:
+    """Log-bucketed streaming histogram with exact deterministic merges.
+
+    Values land in geometric buckets ``(gamma**(k-1), gamma**k]`` with
+    ``gamma = (1 + alpha) / (1 - alpha)``; any quantile read back from
+    a bucket midpoint is within ``alpha`` relative error of the true
+    value (the documented bucket error — the consistency contract
+    tested against :func:`~.recorder.percentiles` on identical
+    streams). Non-positive values land in a dedicated zero bucket.
+
+    Memory is bounded by the number of occupied buckets (~``log(max /
+    min) / log(gamma)``), independent of stream length. Merging adds
+    bucket counts — exact, associative and commutative, so per-replica
+    sketches fold into a fleet sketch in any order with byte-identical
+    :meth:`snapshot` results.
+    """
+
+    __slots__ = ("alpha", "_gamma", "_log_gamma", "buckets",
+                 "zero_count", "count", "sum", "min", "max")
+
+    def __init__(self, alpha: float = 0.05):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = float(alpha)
+        self._gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._log_gamma = math.log(self._gamma)
+        self.buckets: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def _index(self, v: float) -> int:
+        return int(math.ceil(math.log(v) / self._log_gamma))
+
+    def _value(self, idx: int) -> float:
+        """The bucket's representative value: the midpoint of
+        ``(gamma**(idx-1), gamma**idx]`` — within ``alpha`` relative
+        error of anything that landed there."""
+        return (self._gamma ** idx) * 2.0 / (1.0 + self._gamma)
+
+    def add(self, v: float, n: int = 1) -> None:
+        v = float(v)
+        n = int(n)
+        if n <= 0:
+            return
+        if v <= 0.0 or not math.isfinite(v):
+            self.zero_count += n
+        else:
+            idx = self._index(v)
+            self.buckets[idx] = self.buckets.get(idx, 0) + n
+        self.count += n
+        self.sum += v * n
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def merge(self, other: "LogBucketHistogram") -> "LogBucketHistogram":
+        """Fold ``other`` into ``self`` (in place; returns self).
+        Exact: bucket counts add. Requires equal ``alpha`` — merging
+        sketches of different resolution would silently lose the
+        documented error bound."""
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with alpha {self.alpha} != "
+                f"{other.alpha}")
+        for idx in sorted(other.buckets):
+            self.buckets[idx] = (self.buckets.get(idx, 0)
+                                 + other.buckets[idx])
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None:
+            self.min = (other.min if self.min is None
+                        else min(self.min, other.min))
+        if other.max is not None:
+            self.max = (other.max if self.max is None
+                        else max(self.max, other.max))
+        return self
+
+    @classmethod
+    def merged(cls, a: "LogBucketHistogram",
+               b: "LogBucketHistogram") -> "LogBucketHistogram":
+        """A fresh sketch holding ``a + b`` (order-independent:
+        ``merged(a, b).snapshot() == merged(b, a).snapshot()``
+        byte-identically)."""
+        out = cls(alpha=a.alpha)
+        out.merge(a)
+        out.merge(b)
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The q-quantile (``q`` in [0, 1]): within ``alpha`` relative
+        error of the exact nearest-rank quantile (the ``ceil(q * n)``-th
+        smallest value); None on an empty sketch. On smooth latency-like
+        streams this agrees with :func:`~.recorder.percentiles` (which
+        linearly interpolates) to within the same bucket error; the
+        conventions only diverge when the quantile falls in a gap of the
+        distribution (adjacent order statistics far apart)."""
+        if self.count == 0:
+            return None
+        rank = max(1, int(math.ceil(q * self.count)))
+        seen = self.zero_count
+        if rank <= seen:
+            # non-positive values are stored unbucketed; min is exact
+            # when everything is non-positive, 0.0 is the best bound
+            return (self.min if self.min is not None
+                    and self.min <= 0.0 else 0.0)
+        for idx in sorted(self.buckets):
+            seen += self.buckets[idx]
+            if rank <= seen:
+                return self._value(idx)
+        return self.max  # numeric belt: rank beyond the last bucket
+
+    def percentiles(self, ps: Iterable[float] = (50, 90, 99)
+                    ) -> Dict[str, Optional[float]]:
+        """``{"p50": ..., ...}`` — shaped like
+        :func:`~.recorder.percentiles` for drop-in summary use."""
+        return {f"p{g:g}": self.quantile(g / 100.0) for g in ps}
+
+    def snapshot(self) -> dict:
+        """A JSON-stable view: sorted bucket keys, exact counts. Two
+        sketches that saw the same multiset of values in any
+        interleaving produce byte-identical serializations."""
+        return {
+            "alpha": self.alpha,
+            "count": self.count,
+            "zero_count": self.zero_count,
+            "sum": round(self.sum, 9),
+            "min": self.min,
+            "max": self.max,
+            "buckets": {str(k): self.buckets[k]
+                        for k in sorted(self.buckets)},
+        }
+
+
+# event -> (counter name, label field whose value becomes a label)
+_EVENT_COUNTERS = {
+    "dispatch": ("serving_dispatches_total", None),
+    "shed": ("serving_sheds_total", None),
+    "degrade": ("serving_degrades_total", None),
+    "replica_drain": ("fleet_replica_drains_total", None),
+    "replica_join": ("fleet_replica_joins_total", None),
+    "migrate": ("fleet_migrations_total", None),
+    "migrate_admitted": ("fleet_migrations_admitted_total", None),
+    "migrate_exhausted": ("fleet_migrations_exhausted_total", None),
+    "weight_swap": ("fleet_weight_swaps_total", None),
+    "rolling_update_done": ("fleet_rolling_updates_total", None),
+    "rolling_update_aborted": ("fleet_rolling_update_aborts_total", None),
+    "blackbox": ("blackbox_dumps_total", None),
+    "hang": ("serving_hangs_total", None),
+    "quarantine": ("serving_quarantines_total", None),
+    "checkpoint_failed": ("checkpoint_failures_total", None),
+    "checkpoint_fallback": ("checkpoint_fallbacks_total", None),
+    "world_restart": ("supervisor_world_restarts_total", None),
+    "host_down": ("supervisor_incidents_total", "kind:host_down"),
+    "host_hung": ("supervisor_incidents_total", "kind:host_hung"),
+    "reject": ("serving_rejects_total", "code"),
+    "alert": ("alerts_total", "state"),
+    "response": ("alert_responses_total", "action"),
+}
+
+
+class MetricsAggregator(NullRecorder):
+    """Fold the recorder event stream into labeled fleet aggregates.
+
+    Recorder-protocol: feed it via :meth:`record` (fan it out with a
+    :class:`~.recorder.MultiRecorder`, or let ``ReplicaFleet(health=
+    ...)`` compose it). Purely host-side and clock-free: aggregation
+    never times anything, it only counts and buckets what the existing
+    emission sites already measured, so it adds zero clock reads and
+    zero host syncs to any path (hot or not).
+
+    ``static_labels`` are merged under every series (the aggregator's
+    own identity — e.g. a per-cell collector); record labels win.
+    """
+
+    def __init__(self, *, alpha: float = 0.05, max_series: int = 256,
+                 static_labels: Optional[dict] = None):
+        self.alpha = float(alpha)
+        self.max_series = int(max_series)
+        self.static_labels = dict(static_labels or {})
+        self.counters: Dict[str, Dict[LabelKey, float]] = {}
+        self.gauges: Dict[str, Dict[LabelKey, float]] = {}
+        self.histograms: Dict[str, Dict[LabelKey, LogBucketHistogram]] = {}
+        self.dropped_series = 0
+        self.records_seen = 0
+
+    # -- primitive updates -------------------------------------------------
+    def _series(self, family: Dict[str, dict], name: str,
+                key: LabelKey, default):
+        fam = family.setdefault(name, {})
+        if key not in fam:
+            if len(fam) >= self.max_series:
+                self.dropped_series += 1
+                return None
+            fam[key] = default() if callable(default) else default
+        return fam
+
+    def inc(self, name: str, key: LabelKey = (), n: float = 1.0) -> None:
+        fam = self._series(self.counters, name, key, 0.0)
+        if fam is not None:
+            fam[key] += n
+
+    def set_gauge(self, name: str, key: LabelKey, v: float) -> None:
+        fam = self._series(self.gauges, name, key, 0.0)
+        if fam is not None:
+            fam[key] = float(v)
+
+    def observe(self, name: str, key: LabelKey, v: float) -> None:
+        fam = self._series(self.histograms, name, key,
+                           lambda: LogBucketHistogram(alpha=self.alpha))
+        if fam is not None:
+            fam[key].add(v)
+
+    # -- the recorder protocol ---------------------------------------------
+    def record(self, rec: dict) -> None:
+        self.records_seen += 1
+        event = rec.get("event")
+        if not isinstance(event, str):
+            return
+        key = label_key(rec, self.static_labels or None)
+        handler = getattr(self, f"_on_{event}", None)
+        if handler is not None:
+            handler(rec, key)
+            return
+        mapped = _EVENT_COUNTERS.get(event)
+        if mapped is not None:
+            name, lab = mapped
+            if lab is None:
+                self.inc(name, key)
+            elif ":" in lab:  # fixed label baked into the mapping
+                k, v = lab.split(":", 1)
+                self.inc(name, label_key(rec, {**(self.static_labels
+                                                  or {}), k: v}))
+            else:
+                self.inc(name, label_key(
+                    rec, {**(self.static_labels or {}),
+                          lab: rec.get(lab)}))
+
+    def add_scalar(self, name, value, step) -> None:
+        self.record({"event": "scalar", "name": name, "value": value,
+                     "step": step})
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- event handlers ----------------------------------------------------
+    def _on_serving_step(self, rec: dict, key: LabelKey) -> None:
+        self.inc("serving_steps_total", key)
+        for field, gauge in (("queue_depth", "serving_queue_depth"),
+                             ("occupancy", "serving_occupancy"),
+                             ("free_pages", "serving_free_pages"),
+                             ("active", "serving_active_slots")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.set_gauge(gauge, key, v)
+        # a heartbeat IS liveness: any replica emitting steps is up
+        self.set_gauge("replica_up", key, 1.0)
+
+    def _on_request_end(self, rec: dict, key: LabelKey) -> None:
+        status = rec.get("status")
+        self.inc("requests_total", label_key(
+            rec, {**(self.static_labels or {}), "status": status}))
+        slo_ok = rec.get("slo_ok")
+        if slo_ok is True and status == "completed":
+            self.inc("slo_good_total", key)
+            gen = rec.get("generated")
+            if isinstance(gen, (int, float)):
+                self.inc("goodput_tokens_total", key, float(gen))
+        elif slo_ok is not None or status != "completed":
+            # violated budget, or never completed: both burn budget
+            self.inc("slo_bad_total", key)
+        gen = rec.get("generated")
+        if isinstance(gen, (int, float)):
+            self.inc("generated_tokens_total", key, float(gen))
+        for field, hist in (("ttft_ms", "ttft_ms"),
+                            ("latency_ms", "latency_ms")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.observe(hist, key, float(v))
+
+    def _on_replica_down(self, rec: dict, key: LabelKey) -> None:
+        self.inc("fleet_replica_down_total", key)
+        self.set_gauge("replica_up", key, 0.0)
+
+    def _on_replica_restart(self, rec: dict, key: LabelKey) -> None:
+        self.inc("fleet_replica_restarts_total", key)
+        self.set_gauge("replica_up", key, 1.0)
+
+    def _on_checkpoint_saved(self, rec: dict, key: LabelKey) -> None:
+        self.inc("checkpoint_saves_total", key)
+        v = rec.get("duration_s")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            self.observe("checkpoint_save_s", key, float(v))
+
+    def _on_checkpoint_commit(self, rec: dict, key: LabelKey) -> None:
+        self.inc("checkpoint_commits_total", key)
+        v = rec.get("commit_latency_s")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            self.observe("checkpoint_commit_s", key, float(v))
+
+    # -- derived reads (the SLO layer's source) ----------------------------
+    def counter_total(self, name: str) -> float:
+        return float(sum((self.counters.get(name) or {}).values()))
+
+    def gauge_values(self, name: str) -> Dict[LabelKey, float]:
+        return dict(self.gauges.get(name) or {})
+
+    def hist_merged(self, name: str) -> Optional[LogBucketHistogram]:
+        """All of a family's sketches folded into one (fleet-level
+        percentiles) — exact by the merge contract, order-independent
+        because series keys iterate sorted."""
+        fam = self.histograms.get(name)
+        if not fam:
+            return None
+        out = LogBucketHistogram(alpha=self.alpha)
+        for key in sorted(fam):
+            out.merge(fam[key])
+        return out
+
+    # -- snapshots ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The full deterministic aggregate view: every family sorted
+        by name, every series sorted by label key. Two identical record
+        streams produce byte-identical ``json.dumps`` of this."""
+        return {
+            "records_seen": self.records_seen,
+            "dropped_series": self.dropped_series,
+            "counters": {
+                name: {format_labels(k): self.counters[name][k]
+                       for k in sorted(self.counters[name])}
+                for name in sorted(self.counters)},
+            "gauges": {
+                name: {format_labels(k): self.gauges[name][k]
+                       for k in sorted(self.gauges[name])}
+                for name in sorted(self.gauges)},
+            "histograms": {
+                name: {format_labels(k):
+                       self.histograms[name][k].snapshot()
+                       for k in sorted(self.histograms[name])}
+                for name in sorted(self.histograms)},
+        }
+
+    def snapshot_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def to_prom_text(self) -> str:
+        """Prometheus text exposition (counters/gauges verbatim;
+        histograms as ``_count`` / ``_sum`` plus p50/p90/p99 quantile
+        series from the sketch)."""
+        lines = []
+        for name in sorted(self.counters):
+            lines.append(f"# TYPE {name} counter")
+            for k in sorted(self.counters[name]):
+                lines.append(
+                    f"{name}{format_labels(k)} "
+                    f"{_fmt_num(self.counters[name][k])}")
+        for name in sorted(self.gauges):
+            lines.append(f"# TYPE {name} gauge")
+            for k in sorted(self.gauges[name]):
+                lines.append(
+                    f"{name}{format_labels(k)} "
+                    f"{_fmt_num(self.gauges[name][k])}")
+        for name in sorted(self.histograms):
+            lines.append(f"# TYPE {name} summary")
+            for k in sorted(self.histograms[name]):
+                h = self.histograms[name][k]
+                for q in (0.5, 0.9, 0.99):
+                    v = h.quantile(q)
+                    qk = k + (("quantile", f"{q:g}"),)
+                    lines.append(f"{name}{format_labels(qk)} "
+                                 f"{_fmt_num(v if v is not None else 0)}")
+                lines.append(
+                    f"{name}_sum{format_labels(k)} {_fmt_num(h.sum)}")
+                lines.append(
+                    f"{name}_count{format_labels(k)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_num(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)

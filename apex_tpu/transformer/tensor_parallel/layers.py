@@ -118,9 +118,12 @@ def column_parallel_linear(
     ``gradient_accumulation_fusion`` configure overlap/fusion mechanics that
     XLA owns on TPU; accepted for parity, no-ops here.
 
+    Returns ``(out, out_bias, new_fp8_state)`` — ALWAYS a 3-tuple.
     ``fp8_state`` (an ``Fp8DenseState`` with grad meta) switches the shard
     GEMM to the e4m3/e5m2 delayed-scaling path; pass the per-layer
-    ``fp8_grad_carrier`` and get a THIRD return value, the rolled state.
+    ``fp8_grad_carrier`` and the third slot carries the rolled state.
+    With fp8 off the slot is ``None``, so callers thread one arity
+    regardless of the numerics mode.
     """
     del async_tensor_model_parallel_allreduce, gradient_accumulation_fusion
     a = _axis(axis_name)
@@ -142,9 +145,7 @@ def column_parallel_linear(
             )
         out = mappings.gather_from_tensor_model_parallel_region(out, a)
     out_bias = bias if skip_bias_add else None
-    if fp8_state is not None:
-        return out, out_bias, new_fp8
-    return out, out_bias
+    return out, out_bias, new_fp8
 
 
 @jax.named_scope("apex_tpu.row_parallel_linear")
@@ -170,10 +171,12 @@ def row_parallel_linear(
     reduce-scatter along the sequence dim under sequence parallelism. Bias is
     added *after* the reduction (only once).
 
+    Returns ``(out, out_bias, new_fp8_state)`` — ALWAYS a 3-tuple.
     ``fp8_state``/``fp8_grad_carrier``: as in
     :func:`column_parallel_linear` — the shard GEMM (quantized per-shard,
     amax group-reduced) runs in fp8 BEFORE the partial-sum reduction, and
-    the rolled state comes back as a third return value.
+    the rolled state comes back in the third slot (``None`` with fp8
+    off — one arity regardless of the numerics mode).
     """
     del gradient_accumulation_fusion
     a = _axis(axis_name)
@@ -197,9 +200,7 @@ def row_parallel_linear(
     if bias is not None and not skip_bias_add:
         out = out + bias
     out_bias = bias if skip_bias_add else None
-    if fp8_state is not None:
-        return out, out_bias, new_fp8
-    return out, out_bias
+    return out, out_bias, new_fp8
 
 
 @jax.named_scope("apex_tpu.vocab_parallel_embedding")
@@ -257,7 +258,9 @@ if _HAVE_FLAX:
 
     class ColumnParallelLinear(nn.Module):
         """Flax module over :func:`column_parallel_linear`
-        (reference class ``layers.py:460-643``)."""
+        (reference class ``layers.py:460-643``); returns the core's
+        ``(out, out_bias, new_fp8_state)`` 3-tuple (fp8 slot ``None``
+        here — the module runs the plain GEMM path)."""
 
         input_size: int
         output_size: int
@@ -301,7 +304,9 @@ if _HAVE_FLAX:
 
     class RowParallelLinear(nn.Module):
         """Flax module over :func:`row_parallel_linear`
-        (reference class ``layers.py:645-750``)."""
+        (reference class ``layers.py:645-750``); returns the core's
+        ``(out, out_bias, new_fp8_state)`` 3-tuple (fp8 slot ``None``
+        here — the module runs the plain GEMM path)."""
 
         input_size: int
         output_size: int

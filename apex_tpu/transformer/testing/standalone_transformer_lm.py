@@ -344,7 +344,7 @@ def parallel_attention(
             cfg, fp8, "qkv", hidden, lp["qkv_w"].astype(hidden.dtype),
             lp["qkv_b"])
     elif axis_name is not None:
-        qkv, _ = column_parallel_linear(
+        qkv, _, _ = column_parallel_linear(
             hidden, lp["qkv_w"].astype(hidden.dtype),
             lp["qkv_b"].astype(hidden.dtype), axis_name=axis_name,
             gather_output=False,
@@ -577,7 +577,7 @@ def _attn_out_proj(cfg, lp, ctx, axis_name, fp8=None, new_fp8=None,
             bias)
         return out, new_fp8
     if axis_name is not None:
-        out, _ = row_parallel_linear(
+        out, _, _ = row_parallel_linear(
             ctx, lp["proj_w"].astype(ctx.dtype),
             None if bias is None else bias.astype(ctx.dtype),
             axis_name=axis_name,
@@ -654,7 +654,7 @@ def parallel_mlp(
             fc2_b)
         return out, new_fp8
     if axis_name is not None:
-        inter, _ = column_parallel_linear(
+        inter, _, _ = column_parallel_linear(
             hidden, lp["fc1_w"].astype(hidden.dtype),
             None if fc1_b is None else fc1_b.astype(hidden.dtype),
             axis_name=axis_name,
@@ -662,7 +662,7 @@ def parallel_mlp(
             sequence_parallel_enabled=cfg.sequence_parallel,
         )
         inter = act(inter)
-        out, _ = row_parallel_linear(
+        out, _, _ = row_parallel_linear(
             inter, lp["fc2_w"].astype(inter.dtype),
             None if fc2_b is None else fc2_b.astype(inter.dtype),
             axis_name=axis_name,

@@ -1109,6 +1109,271 @@ def bench_serving_fleet():
     }}
 
 
+def bench_serving_slo_guard():
+    """``serving_slo_guard`` leg: the alert→degrade control loop under
+    a ramping overload (the fleet health plane — ISSUE-18).
+
+    Two single-replica fleets serve the SAME three-phase trace: a
+    sustainable warm-up long enough to build error-budget runway, a
+    burst at ``BENCH_SLO_GUARD_FACTOR``x (default 4x) the sustainable
+    arrival rate that builds a queue backlog, then a recovery phase
+    back at the sustainable rate. Both arms run identical admission
+    control (bounded queue + watermark backpressure + token-budget
+    feasibility); only the guarded arm carries a
+    :class:`~apex_tpu.telemetry.alerts.HealthMonitor` whose
+    ``slo_attainment`` burn-rate alert arms a
+    :class:`~apex_tpu.serving.robustness.DegradationPolicy` through
+    the :class:`~apex_tpu.telemetry.alerts.FleetResponder` once the
+    burst starts burning budget — and relaxes it when the alert
+    resolves. The actuator that pays is the ``cap_max_new`` boundary
+    cap: queued (not-yet-decoding) requests are truncated while the
+    queue sits above the high watermark, so the guarded backlog drains
+    in a fraction of the time — late-but-capped burst requests finish
+    inside their budgets, backpressure clears before the recovery
+    phase arrives, and recovery requests are admitted against a short
+    queue. The unguarded arm serves its full-length backlog: queued
+    burst requests miss their budgets, and recovery arrivals meet a
+    queue whose estimated wait makes them deadline-infeasible.
+
+    Budgets and alert windows are denominated in calibrated serving
+    time (a throwaway fleet measures the uncontended request latency
+    and wall time per boundary), so the leg is scale-free across hosts
+    and model sizes.
+
+    What compare_bench gates: the guard must DETECT in time
+    (``alert_detection_steps`` — fleet steps from burst start to the
+    first firing alert; ``fired_before_collapse`` pins that the
+    cumulative attainment at that moment is still >= the objective)
+    and the closed loop must PAY (``guarded_attainment`` >=
+    unguarded on the same trace).
+
+    Burn thresholds scale with the budget: the SRE book's fast-burn 8x
+    assumes a 0.1%-error-budget month; against a bench-scale objective
+    the page threshold must stay reachable (burn cannot exceed
+    ``1 / (1 - objective)``), so ``BENCH_SLO_GUARD_FAST_BURN`` /
+    ``_SLOW_BURN`` expose both knobs (defaults 8 / 2).
+    """
+    import numpy as _np
+
+    from apex_tpu import telemetry
+    from apex_tpu.serving import (
+        AdmissionConfig, DegradationPolicy, ReplicaFleet, Request,
+    )
+    from apex_tpu.telemetry import SLO, HealthMonitor, SLOTracker
+    from apex_tpu.transformer.testing import GPTConfig, init_gpt_params
+
+    factor = float(os.environ.get("BENCH_SLO_GUARD_FACTOR", "4.0"))
+    n_req = int(os.environ.get("BENCH_SLO_GUARD_REQUESTS", "36"))
+    n_warm = int(os.environ.get(
+        "BENCH_SLO_GUARD_WARMUP", str(n_req // 2)))
+    n_recover = int(os.environ.get(
+        "BENCH_SLO_GUARD_RECOVERY", str(n_req // 4)))
+    n_burst = n_req - n_warm - n_recover
+    objective = float(os.environ.get("BENCH_SLO_GUARD_OBJECTIVE", "0.9"))
+    budget_x = float(os.environ.get("BENCH_SLO_GUARD_BUDGET_X", "3.0"))
+    fast_burn = float(os.environ.get("BENCH_SLO_GUARD_FAST_BURN", "8.0"))
+    slow_burn = float(os.environ.get("BENCH_SLO_GUARD_SLOW_BURN", "2.0"))
+    prompt_len = int(os.environ.get("BENCH_SERVING_PROMPT", "128"))
+    max_new = int(os.environ.get("BENCH_SERVING_NEW", "64"))
+    n_slots = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+    layers = int(os.environ.get(
+        "BENCH_SERVING_LAYERS", os.environ.get("BENCH_GPT_LAYERS", "24")))
+    hidden = int(os.environ.get("BENCH_SLO_GUARD_HIDDEN", "1024"))
+    cfg = GPTConfig(
+        num_layers=layers, num_attention_heads=max(4, hidden // 64),
+        hidden_size=hidden, vocab_size=50304,
+        max_position_embeddings=max(256, prompt_len + max_new),
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    params = init_gpt_params(cfg, jax.random.PRNGKey(0))
+
+    service_steps = prompt_len + max_new
+    sustainable = max(1, service_steps // n_slots)
+    ramp_interval = max(1, int(sustainable / factor))
+    ramp_start = n_warm * sustainable
+    burst_end = ramp_start + n_burst * ramp_interval
+
+    # calibration on a throwaway fleet: prime the compile cache and
+    # measure the uncontended request latency / TTFT and the wall time
+    # per scheduling boundary — the units the budgets and alert
+    # windows are denominated in
+    crng = _np.random.default_rng(1)
+    calib = ReplicaFleet(
+        cfg, params, n_replicas=1, n_slots=n_slots,
+        sink=telemetry_recorder())
+    calib.generate(
+        [Request(
+            prompt=[int(t) for t in
+                    crng.integers(0, cfg.vocab_size, size=prompt_len)],
+            max_new_tokens=max_new, arrival_step=i * sustainable)
+         for i in range(min(4, n_slots))],
+        max_steps=service_steps * 8 + 500)
+    cst = calib.last_stats
+    svc_ms = cst["latency_ms"].get("p50") or float(service_steps)
+    ttft_p50_ms = cst["ttft_ms"].get("p50") or float(prompt_len)
+    step_s = cst["wall_s"] / cst["steps"] if cst["steps"] else 1.0
+    del calib
+
+    budget_ms = svc_ms * budget_x
+    ttft_x = float(os.environ.get(
+        "BENCH_SLO_GUARD_TTFT_X", str(4.0 * budget_x)))
+    ttft_ms = ttft_p50_ms * ttft_x
+    # alert windows denominated in measured boundary time: the
+    # fast/page window spans BENCH_SLO_GUARD_FAST_WINDOW boundaries
+    # (default 24), the slow/ticket window 4x that — scale-free across
+    # hardware and model sizes because the per-boundary time is
+    # measured
+    fast_win_steps = float(os.environ.get(
+        "BENCH_SLO_GUARD_FAST_WINDOW", "24"))
+    slow_win_steps = float(os.environ.get(
+        "BENCH_SLO_GUARD_SLOW_WINDOW", str(4.0 * fast_win_steps)))
+    fast_window_s = fast_win_steps * step_s
+    slow_window_s = slow_win_steps * step_s
+
+    def build_trace():
+        # both arms regenerate the identical trace (fresh seed-0 rng:
+        # Request objects are mutated by a run, so they cannot be shared)
+        trng = _np.random.default_rng(0)
+        out = []
+        for i in range(n_req):
+            if i < n_warm:
+                arrival = i * sustainable
+            elif i < n_warm + n_burst:
+                arrival = ramp_start + (i - n_warm) * ramp_interval
+            else:
+                arrival = (burst_end
+                           + (i - n_warm - n_burst + 1) * sustainable)
+            out.append(Request(
+                prompt=[int(t) for t in
+                        trng.integers(0, cfg.vocab_size, size=prompt_len)],
+                max_new_tokens=max_new, arrival_step=arrival,
+                latency_budget_ms=budget_ms, ttft_budget_ms=ttft_ms))
+        return out
+
+    # watermarks sit BELOW the depth where the token-budget feasibility
+    # check starts refusing (est wait > budget): pressure must latch —
+    # and the degradation cap must engage — while admission is still
+    # the queue's problem, not after feasibility has slammed the door
+    high_wm = float(os.environ.get("BENCH_SLO_GUARD_HIGH_WM", "0.375"))
+    low_wm = float(os.environ.get("BENCH_SLO_GUARD_LOW_WM", "0.125"))
+
+    def mk_admission():
+        return AdmissionConfig(max_queue=4 * n_slots,
+                               high_watermark=high_wm,
+                               low_watermark=low_wm)
+
+    max_steps = service_steps * n_req + 2000
+
+    class _AlertTap(telemetry.NullRecorder):
+        """Capture alert transitions off the fleet's fan-in (they carry
+        the boundary step the detection metric is denominated in)."""
+
+        def __init__(self):
+            self.alerts = []
+
+        def record(self, rec):
+            if rec.get("event") == "alert":
+                self.alerts.append(dict(rec))
+
+    # -- unguarded arm: same admission control, nobody watching ----------
+    unguarded = ReplicaFleet(
+        cfg, params, n_replicas=1, n_slots=n_slots,
+        sink=telemetry_recorder(), admission=mk_admission())
+    unguarded.generate(build_trace(), max_steps=max_steps)
+    unguarded.check_invariants()
+    ust = unguarded.last_stats
+
+    # -- guarded arm: health plane closes the loop -----------------------
+    health = HealthMonitor(slos=[SLOTracker(
+        SLO(name="slo_attainment", objective=objective, kind="ratio",
+            fast_window_s=fast_window_s, fast_burn=fast_burn,
+            slow_window_s=slow_window_s, slow_burn=slow_burn),
+        lambda agg: (agg.counter_total("slo_good_total"),
+                     agg.counter_total("slo_bad_total")))])
+    tap = _AlertTap()
+    guarded = ReplicaFleet(
+        cfg, params, n_replicas=1, n_slots=n_slots,
+        sink=telemetry.MultiRecorder(telemetry_recorder(), tap),
+        admission=mk_admission(), health=health)
+    # degradation scaled to this trace (the responder default caps at
+    # 32 new tokens, meaningless when max_new is already smaller): the
+    # cap is the lever that pays — capped admissions take a fraction of
+    # the service time, so the guarded arm drains its backlog before
+    # the recovery phase arrives
+    shed_after = int(os.environ.get("BENCH_SLO_GUARD_SHED_AFTER", "2"))
+    cap_new = int(os.environ.get(
+        "BENCH_SLO_GUARD_CAP_NEW", str(max(1, max_new // 4))))
+    health.fleet_responder.degradation = DegradationPolicy(
+        shed_after=shed_after, cap_max_new=cap_new)
+    guarded.generate(build_trace(), max_steps=max_steps)
+    guarded.check_invariants()
+    gst = guarded.last_stats
+
+    tracker = health.manager.tracker("slo_attainment")
+    fired = [a for a in tap.alerts
+             if a.get("name") == "slo_attainment"
+             and a.get("state") == "firing"]
+    first = fired[0] if fired else None
+    alert_step = first.get("step") if first else None
+    attainment_at_fire = first.get("attainment") if first else None
+    actions = {}
+    for a in health.fleet_responder.actions:
+        actions[a["action"]] = actions.get(a["action"], 0) + 1
+    return {"serving_slo_guard": {
+        "overload_factor": factor,
+        "n_requests": n_req,
+        "warmup_requests": n_warm,
+        "burst_requests": n_burst,
+        "recovery_requests": n_recover,
+        "objective": objective,
+        "budget_multiple": budget_x,
+        "fast_burn": fast_burn,
+        "slow_burn": slow_burn,
+        "sustainable_interval_steps": sustainable,
+        "ramp_interval_steps": ramp_interval,
+        "ramp_start_step": ramp_start,
+        "burst_end_step": burst_end,
+        # the headline A/B: same trace, same admission control — only
+        # the health plane differs
+        "guarded_attainment": gst["slo_attainment"],
+        "unguarded_attainment": ust["slo_attainment"],
+        "attainment_delta": (
+            round(gst["slo_attainment"] - ust["slo_attainment"], 4)
+            if gst["slo_attainment"] is not None
+            and ust["slo_attainment"] is not None else None),
+        # detection: fleet steps from ramp start to the first firing
+        # slo_attainment alert; fired_before_collapse pins that the
+        # cumulative attainment had not yet crossed the objective
+        "alert_fired_step": alert_step,
+        "alert_detection_steps": (
+            alert_step - ramp_start if alert_step is not None else None),
+        "attainment_at_fire": attainment_at_fire,
+        "fired_before_collapse": bool(
+            first is not None and attainment_at_fire is not None
+            and attainment_at_fire >= objective),
+        "alerts_fired": tracker.fired_count,
+        "alerts_resolved": tracker.resolved_count,
+        "budget_remaining_final": round(tracker.budget.remaining, 4),
+        "responder_actions": actions,
+        "guarded_by_status": gst["by_status"],
+        "unguarded_by_status": ust["by_status"],
+        "guarded_goodput_tokens_per_sec": gst["goodput_tokens_per_sec"],
+        "unguarded_goodput_tokens_per_sec": ust["goodput_tokens_per_sec"],
+        "page_leaks_guarded": guarded.page_leaks(),
+        "page_leaks_unguarded": unguarded.page_leaks(),
+        "fast_window_s": round(fast_window_s, 4),
+        "slow_window_s": round(slow_window_s, 4),
+        "latency_budget_ms": round(budget_ms, 1),
+        "ttft_budget_ms": round(ttft_ms, 1),
+        "calib_s_per_step": round(step_s, 4),
+        "slots": n_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "hidden_size": hidden,
+        "layers": layers,
+    }}
+
+
 def bench_serving_tp():
     """``serving_tp`` leg: the equal-chip DP-vs-TP A/B (ISSUE-16).
 
@@ -2327,6 +2592,23 @@ def main() -> None:
             print(f"serving fleet bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # slo-guard leg: the fleet health plane's closed loop (ISSUE-18) —
+    # the same ramping-overload trace served guarded (burn-rate alert
+    # arms degradation) and unguarded; compare_bench gates the guarded
+    # attainment and the detection latency. Gated like the serving legs
+    # (BENCH_SLO_GUARD overrides).
+    serving_slo_guard = None
+    want_slo_guard = os.environ.get("BENCH_SLO_GUARD", want_serving)
+    if want_slo_guard != "0" and (not fast or want_slo_guard == "1"):
+        try:
+            serving_slo_guard = _retry_transient(
+                bench_serving_slo_guard, tag="serving slo guard leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"serving slo guard bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     # tensor-parallel leg: the equal-chip DP-vs-TP A/B — the TP arm's
     # tokens/sec + p99 latency (compare_bench-gated) against the pure-
     # DP fleet on the same chips, plus per-chip KV bytes and the pinned
@@ -2483,6 +2765,8 @@ def main() -> None:
         "prefill_decode_split": (serving or {}).get("prefill_decode_split"),
         "serving_overload": (serving_overload or {}).get("serving_overload"),
         "serving_fleet": (serving_fleet or {}).get("serving_fleet"),
+        "serving_slo_guard": (serving_slo_guard
+                              or {}).get("serving_slo_guard"),
         "serving_tp": (serving_tp or {}).get("serving_tp"),
         "prefix_reuse": (prefix_reuse or {}).get("prefix_reuse"),
         "spec_decode": (spec_decode or {}).get("spec_decode"),

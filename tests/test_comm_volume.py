@@ -111,9 +111,9 @@ def test_tp_step_allreduce_volume():
 
     def f(x, wc, wr):
         def loss(x, wc, wr):
-            y, _ = column_parallel_linear(
+            y, _, _ = column_parallel_linear(
                 x, wc, axis_name="tensor", gather_output=False)
-            z, _ = row_parallel_linear(
+            z, _, _ = row_parallel_linear(
                 jnp.tanh(y), wr, axis_name="tensor", input_is_parallel=True)
             return jnp.mean((z - tgt) ** 2)
 
@@ -159,10 +159,10 @@ def test_sp_step_gather_scatter_volume():
 
     def f(x, wc, wr):
         def loss(x, wc, wr):
-            y, _ = column_parallel_linear(
+            y, _, _ = column_parallel_linear(
                 x, wc, axis_name="tensor", gather_output=False,
                 sequence_parallel_enabled=True)
-            z, _ = row_parallel_linear(
+            z, _, _ = row_parallel_linear(
                 jnp.tanh(y), wr, axis_name="tensor", input_is_parallel=True,
                 sequence_parallel_enabled=True)
             return jnp.sum(z ** 2)

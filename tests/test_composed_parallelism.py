@@ -95,7 +95,7 @@ def test_tp_pp_dp_composed_gradients_match_dense(mesh3d):
     data_spec = P(None, d, None)
 
     def stage_fn(lp, x):
-        y, _ = column_parallel_linear(
+        y, _, _ = column_parallel_linear(
             x, lp["w"], lp["b"], axis_name=t, gather_output=True
         )
         return jnp.tanh(y)
@@ -161,7 +161,7 @@ def test_composed_forward_only_loss(mesh3d):
     pspec = {"w": P(pl, t, None), "b": P(pl, t)}
 
     def stage_fn(lp, x):
-        y, _ = column_parallel_linear(
+        y, _, _ = column_parallel_linear(
             x, lp["w"], lp["b"], axis_name=t, gather_output=True
         )
         return jnp.tanh(y)

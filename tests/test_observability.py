@@ -37,9 +37,9 @@ def _compiled_tp_step():
 
     def f(x, wc, wr):
         def loss(x, wc, wr):
-            y, _ = column_parallel_linear(
+            y, _, _ = column_parallel_linear(
                 x, wc, axis_name="tensor", gather_output=False)
-            z, _ = row_parallel_linear(
+            z, _, _ = row_parallel_linear(
                 jnp.tanh(y), wr, axis_name="tensor", input_is_parallel=True)
             return jnp.mean((z - tgt) ** 2)
 
@@ -186,10 +186,10 @@ def test_sequence_parallel_linears_compile_to_gather_scatter_pair():
 
     def f(x, wc, wr):
         def loss(x, wc, wr):
-            y, _ = column_parallel_linear(
+            y, _, _ = column_parallel_linear(
                 x, wc, axis_name="tensor", gather_output=False,
                 sequence_parallel_enabled=True)
-            z, _ = row_parallel_linear(
+            z, _, _ = row_parallel_linear(
                 jnp.tanh(y), wr, axis_name="tensor", input_is_parallel=True,
                 sequence_parallel_enabled=True)
             return jnp.sum(z ** 2)

@@ -138,7 +138,7 @@ def test_column_parallel_linear_matches_dense():
     b_full = jax.random.normal(jax.random.PRNGKey(5), (out_f,)) * 0.1
 
     def f(x_rep, w_shard, b_shard):
-        out, _ = tp.column_parallel_linear(
+        out, _, _ = tp.column_parallel_linear(
             x_rep, w_shard, b_shard, axis_name="tensor", gather_output=True
         )
         return out
@@ -157,7 +157,7 @@ def test_row_parallel_linear_matches_dense():
     b = jax.random.normal(jax.random.PRNGKey(8), (out_f,)) * 0.1
 
     def f(x_rep, w_shard, b_rep):
-        out, _ = tp.row_parallel_linear(
+        out, _, _ = tp.row_parallel_linear(
             x_rep, w_shard, b_rep, axis_name="tensor", input_is_parallel=False
         )
         return out
@@ -180,11 +180,11 @@ def test_column_row_pair_backward_matches_dense():
         return jnp.sum((h @ w2.T) ** 2)
 
     def tp_loss(x_rep, w1_s, w2_s):
-        h, _ = tp.column_parallel_linear(
+        h, _, _ = tp.column_parallel_linear(
             x_rep, w1_s, None, axis_name="tensor", gather_output=False
         )
         h = jax.nn.gelu(h)
-        y, _ = tp.row_parallel_linear(
+        y, _, _ = tp.row_parallel_linear(
             h, w2_s, None, axis_name="tensor", input_is_parallel=True
         )
         return jnp.sum(y**2)

@@ -107,7 +107,7 @@ def test_vocab_sharded_tied_embedding_matches_dense(pp, dp, tp):
         data_spec = P(None, d, None)
 
         def stage_fn(lp, x):
-            y, _ = column_parallel_linear(
+            y, _, _ = column_parallel_linear(
                 x, lp["w"], lp["b"], axis_name=t, gather_output=True
             )
             return jnp.tanh(y)

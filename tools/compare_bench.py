@@ -59,6 +59,14 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("fleet_goodput", "serving_fleet.goodput_tokens_per_sec", True),
     ("fleet_requests_lost", "serving_fleet.requests_lost", False),
     ("fleet_ttft_p99_ms", "serving_fleet.ttft_p99_ms", False),
+    # ISSUE-18 fleet health plane: the alert→degrade closed loop on the
+    # ramping-overload A/B — the guarded arm's attainment must not
+    # regress, and the burn-rate alert must keep firing early (steps
+    # from ramp start to the first firing slo_attainment alert)
+    ("slo_guard_attainment", "serving_slo_guard.guarded_attainment",
+     True),
+    ("alert_detection_steps", "serving_slo_guard.alert_detection_steps",
+     False),
     # ISSUE-16 tensor-parallel serving: the TP arm of the equal-chip
     # DP-vs-TP A/B — aggregate decode throughput and p99 request
     # latency of the shard_mapped engine must not regress
@@ -104,6 +112,10 @@ ABS_TOLERANCE = {
     # swings with host load — gate drift, not noise
     "elastic_mttr_s": 5.0,  # seconds (docs/resilience.md elastic)
     "elastic_save_overhead_pct": 12.0,  # percentage points
+    # detection is denominated in fleet steps and the expected value is
+    # a couple dozen; a relative threshold over a small base would flag
+    # single-boundary jitter in when the window fills
+    "alert_detection_steps": 16.0,  # fleet steps (docs/observability.md)
 }
 
 # op-breakdown category diffing (ISSUE-9): a run whose *shape* of device

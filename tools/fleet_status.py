@@ -1,0 +1,629 @@
+"""Fleet health console: status table, error budgets, alerts, Prom text.
+
+The read side of the fleet health plane (``apex_tpu.telemetry``
+timeseries/slo/alerts): fold a telemetry JSONL stream — the one a
+``ReplicaFleet``/``ServingEngine``/elastic service already writes — into
+the :class:`~apex_tpu.telemetry.MetricsAggregator`, replay the SLO
+trackers over it at the stream's own timestamps, and render:
+
+- a per-replica health table (liveness, queue depth, occupancy, free
+  pages, requests by status, deaths/restarts);
+- per-SLO error budgets (state, budget remaining, attainment, episode
+  counts) and the active alerts;
+- ``--prom``: the Prometheus text exposition of every aggregated
+  series (counters/gauges verbatim, histograms as summary quantiles).
+
+Replay is a pure fold over the records — like the aggregator itself it
+reads no clocks, so the same file always renders the same report.
+
+``--self`` runs the health plane's own smokes (the tier-1 CI lane, same
+contract as ``serving_check.py --self``):
+
+- ``hist_accuracy``        sketch quantiles vs the exact
+                           ``telemetry.percentiles`` reducer agree
+                           within the documented ``alpha`` bucket error.
+- ``merge_order``          per-replica sketches folded in any order
+                           produce byte-identical snapshots.
+- ``aggregation_determinism``  one event stream fed to two aggregators
+                           (and shard-merged three ways) produces
+                           byte-identical snapshot JSON.
+- ``burn_rate_alert``      a ramping-overload synthetic stream fires
+                           the fast-burn page BEFORE cumulative
+                           attainment crosses the objective, fires the
+                           episode exactly once, and resolves after
+                           recovery (no flapping).
+- ``responder_actions``    firing alerts drive the actuators: load
+                           alert arms degradation on every live
+                           replica and relaxes on resolve; an
+                           availability alert restarts the dead
+                           replica; a page mid-rolling-update aborts
+                           the wave.
+- ``prom_exposition``      the text exposition is well-formed and
+                           consistent (every series line parses,
+                           summary ``_sum``/``_count`` present).
+
+Usage::
+
+    python tools/fleet_status.py run.jsonl              # health table
+    python tools/fleet_status.py run.jsonl --prom       # exposition
+    python tools/fleet_status.py run.jsonl --json
+    python tools/fleet_status.py --self [--check NAME] [--json]
+
+Exit codes (CI contract, same as serving_check/static_audit): 0 = all
+checks pass / no SLO firing, 1 = a check failed or an alert is firing,
+2 = infra/usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import List, Optional
+
+# script-mode invocation (`python tools/fleet_status.py ...`) puts
+# tools/ at sys.path[0]; the repo root must be importable for apex_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# JSONL replay -> aggregator + SLO evaluation
+
+
+def replay_records(records, *, slos=None, eval_every: int = 16):
+    """Fold a record list into ``(aggregator, trackers, alerts_seen)``.
+
+    SLO trackers are evaluated at the stream's own ``t_wall`` stamps
+    (every ``eval_every`` records — the replay analogue of the fleet's
+    per-boundary cadence); ``alert``/``response`` events already in the
+    stream are collected verbatim so a post-mortem shows what the LIVE
+    manager did, not just what replay would have done.
+    """
+    from apex_tpu.telemetry import MetricsAggregator, default_serving_slos
+
+    agg = MetricsAggregator()
+    trackers = slos if slos is not None else default_serving_slos()
+    alerts_seen: List[dict] = []
+    n = 0
+    last_t: Optional[float] = None
+    for rec in records:
+        if rec.get("event") == "alert":
+            alerts_seen.append(rec)
+        agg.record(rec)
+        n += 1
+        t = rec.get("t_wall", rec.get("t"))
+        if isinstance(t, (int, float)):
+            last_t = float(t)
+        if n % eval_every == 0 and last_t is not None:
+            _evaluate(trackers, agg, last_t)
+    if last_t is not None:
+        _evaluate(trackers, agg, last_t)
+    return agg, trackers, alerts_seen
+
+
+def _evaluate(trackers, agg, now: float) -> None:
+    for t in trackers:
+        src = t.source
+        if hasattr(src, "now"):
+            src.now = now
+        t.evaluate(agg, now)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def format_table(headers: List[str], rows: List[List]) -> str:
+    cells = [[_fmt(c) for c in row] for row in rows]
+    widths = [max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+              for i, h in enumerate(headers)]
+    line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+    sep = "  ".join("-" * w for w in widths)
+    body = "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(row, widths)) for row in cells)
+    return "\n".join([line, sep, body]) if cells else "\n".join([line, sep])
+
+
+def _series_by_replica(family: dict) -> dict:
+    """{replica_id: value} from one metric family's label-keyed series
+    (series without a replica_id label fold under "-")."""
+    out: dict = defaultdict(float)
+    for key, v in (family or {}).items():
+        rid = dict(key).get("replica_id", "-")
+        out[rid] += v
+    return dict(out)
+
+
+def fleet_table(agg) -> dict:
+    """Per-replica health rows from the aggregated series."""
+    up = _series_by_replica(agg.gauges.get("replica_up"))
+    rows = {}
+    for rid in sorted(up, key=str):
+        rows[rid] = {"up": up[rid] > 0}
+    for gauge, col in (("serving_queue_depth", "queue"),
+                       ("serving_occupancy", "occupancy"),
+                       ("serving_free_pages", "free_pages")):
+        for rid, v in _series_by_replica(agg.gauges.get(gauge)).items():
+            rows.setdefault(rid, {})[col] = v
+    for counter, col in (("fleet_replica_down_total", "deaths"),
+                         ("fleet_replica_restarts_total", "restarts"),
+                         ("serving_rejects_total", "rejects"),
+                         ("serving_sheds_total", "sheds")):
+        for rid, v in _series_by_replica(agg.counters.get(counter)).items():
+            rows.setdefault(rid, {})[col] = int(v)
+    # requests by terminal status, re-keyed per replica
+    for key, v in (agg.counters.get("requests_total") or {}).items():
+        kd = dict(key)
+        rid = kd.get("replica_id", "-")
+        st = kd.get("status", "?")
+        d = rows.setdefault(rid, {}).setdefault("requests", {})
+        d[st] = d.get(st, 0) + int(v)
+    return rows
+
+
+def slo_table(trackers) -> List[dict]:
+    return [{
+        "name": t.slo.name,
+        "state": t.state.value,
+        "objective": t.slo.objective,
+        "budget_remaining": round(t.budget.remaining, 4),
+        "attainment": (round(t.budget.attainment, 4)
+                       if t.budget.attainment is not None else None),
+        "fired": t.fired_count,
+        "resolved": t.resolved_count,
+    } for t in sorted(trackers, key=lambda t: t.slo.name)]
+
+
+def render_status(agg, trackers, alerts_seen) -> str:
+    out = []
+    reps = fleet_table(agg)
+    if reps:
+        out.append("fleet replicas")
+        rows = []
+        for rid, d in sorted(reps.items(), key=lambda kv: str(kv[0])):
+            reqs = d.get("requests", {})
+            rows.append([
+                rid, "up" if d.get("up") else "DOWN",
+                d.get("queue"), d.get("occupancy"), d.get("free_pages"),
+                reqs.get("completed", 0),
+                sum(v for k, v in reqs.items() if k != "completed"),
+                d.get("deaths", 0), d.get("restarts", 0),
+                d.get("rejects", 0), d.get("sheds", 0)])
+        out.append(format_table(
+            ["replica", "state", "queue", "occupancy", "free_pages",
+             "completed", "not_completed", "deaths", "restarts",
+             "rejects", "sheds"], rows))
+    out.append("\nSLO error budgets")
+    rows = [[s["name"], s["state"], s["objective"],
+             s["budget_remaining"], s["attainment"], s["fired"],
+             s["resolved"]] for s in slo_table(trackers)]
+    out.append(format_table(
+        ["slo", "state", "objective", "budget_left", "attainment",
+         "fired", "resolved"], rows))
+    firing = [t.slo.name for t in trackers if t.firing]
+    out.append(f"\nactive alerts: {', '.join(firing) if firing else 'none'}")
+    if alerts_seen:
+        out.append(f"alert transitions in stream: {len(alerts_seen)} "
+                   "(live AlertManager events)")
+        for a in alerts_seen[-8:]:
+            out.append(f"  t={_fmt(a.get('t'))} {a.get('name')}: "
+                       f"{a.get('prev_state')} -> {a.get('state')} "
+                       f"(burn fast={_fmt(a.get('burn_fast'))} "
+                       f"slow={_fmt(a.get('burn_slow'))})")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# --self checks
+
+
+def check_hist_accuracy() -> dict:
+    """Sketch quantiles vs the exact reducer within the alpha bound."""
+    import numpy as np
+
+    from apex_tpu.telemetry import LogBucketHistogram, percentiles
+
+    rng = np.random.default_rng(0)
+    worst_nr = 0.0   # vs exact nearest-rank: the hard alpha bound
+    worst_interp = 0.0  # vs percentiles(): holds on smooth streams
+    cases = []
+    for alpha in (0.05, 0.01):
+        for dist in ("lognormal", "uniform", "bimodal"):
+            if dist == "lognormal":
+                vals = rng.lognormal(3.0, 1.0, size=4000)
+            elif dist == "uniform":
+                vals = rng.uniform(0.5, 500.0, size=4000)
+            else:
+                vals = np.concatenate([rng.normal(10, 1, 2000),
+                                       rng.normal(1000, 50, 2000)])
+                vals = np.abs(vals) + 1e-3
+            h = LogBucketHistogram(alpha=alpha)
+            for v in vals:
+                h.add(float(v))
+            srt = np.sort(vals)
+            interp = percentiles(vals.tolist(), ps=(50, 90, 99))
+            rel_nr = rel_in = 0.0
+            for q in (50, 90, 99):
+                got = h.quantile(q / 100.0)
+                nr = float(srt[int(np.ceil(q / 100.0 * len(srt))) - 1])
+                rel_nr = max(rel_nr, abs(got - nr) / nr)
+                # interpolation comparison only where the stream is
+                # smooth — in a bimodal gap the two conventions answer
+                # different questions (see quantile()'s docstring)
+                if dist != "bimodal":
+                    rel_in = max(rel_in,
+                                 abs(got - interp[f"p{q}"])
+                                 / interp[f"p{q}"])
+            worst_nr = max(worst_nr, rel_nr / alpha)
+            worst_interp = max(worst_interp, rel_in / alpha)
+            cases.append({"alpha": alpha, "dist": dist,
+                          "rel_err_over_alpha": round(rel_nr / alpha, 3)})
+    # nearest-rank: the documented alpha bound, every distribution;
+    # percentiles(): same bound + a 1-order-stat interpolation allowance
+    ok = worst_nr <= 1.0 + 1e-9 and worst_interp <= 1.5
+    return {"ok": ok, "worst_vs_nearest_rank": round(worst_nr, 3),
+            "worst_vs_percentiles": round(worst_interp, 3),
+            "cases": cases}
+
+
+def check_merge_order() -> dict:
+    """Per-replica sketches fold order-independently, byte-identical."""
+    import itertools
+    import json as _json
+
+    import numpy as np
+
+    from apex_tpu.telemetry import LogBucketHistogram
+
+    rng = np.random.default_rng(1)
+    shards = []
+    for _ in range(4):
+        h = LogBucketHistogram()
+        for v in rng.lognormal(2.0, 1.5, size=500):
+            h.add(float(v))
+        shards.append(h)
+    snaps = set()
+    for perm in itertools.permutations(range(4)):
+        out = LogBucketHistogram()
+        for i in perm:
+            out.merge(shards[i])
+        snaps.add(_json.dumps(out.snapshot(), sort_keys=True))
+    # and against the single-stream fold
+    return {"ok": len(snaps) == 1, "distinct_snapshots": len(snaps),
+            "permutations": 24}
+
+
+def check_aggregation_determinism() -> dict:
+    """Same stream -> byte-identical aggregator snapshots."""
+    import numpy as np
+
+    from apex_tpu.telemetry import MetricsAggregator
+
+    rng = np.random.default_rng(2)
+    recs = []
+    for i in range(300):
+        rid = int(rng.integers(0, 3))
+        if i % 3 == 0:
+            recs.append({"event": "serving_step", "replica_id": rid,
+                         "step": i, "queue_depth": int(rng.integers(0, 9)),
+                         "occupancy": float(rng.uniform(0, 1)),
+                         "free_pages": int(rng.integers(0, 64)),
+                         "active": int(rng.integers(0, 4))})
+        else:
+            ok = bool(rng.random() > 0.2)
+            recs.append({"event": "request_end", "replica_id": rid,
+                         "rid": i, "status": "completed" if ok
+                         else "timed_out", "reason": "eos",
+                         "generated": int(rng.integers(1, 30)),
+                         "preemptions": 0, "restarts": 0,
+                         "slo_ok": ok,
+                         "ttft_ms": float(rng.lognormal(3, 0.5)),
+                         "latency_ms": float(rng.lognormal(5, 0.5)),
+                         "labels": {"tenant": f"t{rid % 2}"}})
+    a, b = MetricsAggregator(), MetricsAggregator()
+    for r in recs:
+        a.record(r)
+    for r in recs:
+        b.record(r)
+    same_twice = a.snapshot_json() == b.snapshot_json()
+    # merged sketch = the single-stream family fold regardless of how
+    # the stream was sharded across aggregators
+    merged = a.hist_merged("ttft_ms")
+    per_rep = [MetricsAggregator() for _ in range(3)]
+    for r in recs:
+        per_rep[r["replica_id"]].record(r)
+    from apex_tpu.telemetry import LogBucketHistogram
+
+    fold = LogBucketHistogram()
+    for p in per_rep:
+        h = p.hist_merged("ttft_ms")
+        if h is not None:
+            fold.merge(h)
+    shard_same = (merged is not None
+                  and json.dumps(merged.snapshot(), sort_keys=True)
+                  == json.dumps(fold.snapshot(), sort_keys=True))
+    return {"ok": same_twice and shard_same, "same_twice": same_twice,
+            "shard_merge_identical": shard_same}
+
+
+def check_burn_rate_alert() -> dict:
+    """Ramping overload: page fires before attainment crosses the
+    objective, exactly one episode, resolves after recovery."""
+    from apex_tpu.telemetry import MetricsAggregator, default_serving_slos
+
+    agg = MetricsAggregator()
+    trackers = default_serving_slos(attainment_objective=0.9,
+                                    fast_window_s=10.0,
+                                    slow_window_s=40.0)
+    att = next(t for t in trackers if t.slo.name == "slo_attainment")
+    rid = 0
+
+    def submit(t, n_good, n_bad):
+        nonlocal rid
+        for ok in [True] * n_good + [False] * n_bad:
+            rid += 1
+            agg.record({"event": "request_end", "replica_id": 0,
+                        "rid": rid,
+                        "status": "completed" if ok else "timed_out",
+                        "reason": "x", "generated": 4 if ok else 0,
+                        "preemptions": 0, "restarts": 0, "slo_ok": ok})
+
+    fired_at = None
+    attainment_at_fire = None
+    # phase 1: healthy traffic (t 0..90) — builds the budget runway a
+    # cumulative metric would coast on long after service collapses
+    t = 0.0
+    while t < 90.0:
+        submit(t, 8, 0)
+        _evaluate(trackers, agg, t)
+        t += 1.0
+    # phase 2: ramping overload — bad fraction climbs each boundary
+    bad = 0
+    while t < 125.0:
+        bad = min(8, bad + 2)
+        submit(t, 8 - bad, bad)
+        _evaluate(trackers, agg, t)
+        if fired_at is None and att.firing:
+            fired_at = t
+            attainment_at_fire = att.budget.attainment
+        t += 1.0
+    # phase 3: recovery — long enough for the slow window to drain
+    while t < 225.0:
+        submit(t, 8, 0)
+        _evaluate(trackers, agg, t)
+        t += 1.0
+    fired_before_collapse = (
+        fired_at is not None and attainment_at_fire is not None
+        and attainment_at_fire >= att.slo.objective)
+    ok = (fired_before_collapse and att.fired_count == 1
+          and att.state.value == "ok")
+    return {"ok": ok, "fired_at": fired_at,
+            "attainment_at_fire": (round(attainment_at_fire, 4)
+                                   if attainment_at_fire is not None
+                                   else None),
+            "objective": att.slo.objective,
+            "episodes": att.fired_count,
+            "resolved": att.resolved_count,
+            "final_state": att.state.value,
+            "transitions": len(att.timeline)}
+
+
+class _FakeAdmission:
+    def __init__(self):
+        self.degradation = None
+
+    def arm_degradation(self, policy):
+        self.degradation = policy
+
+    def relax_degradation(self, policy=None):
+        self.degradation = policy
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.admission = _FakeAdmission()
+
+
+class _FakeReplica:
+    def __init__(self, idx, live=True):
+        self.idx = idx
+        self.live = live
+        self.engine = _FakeEngine()
+
+
+class _FakeFleet:
+    """Duck-typed stand-in exposing exactly the actuator surface
+    FleetResponder drives (the real fleet is exercised in
+    tests/test_fleet_health.py — this keeps --self in the CPU lane)."""
+
+    def __init__(self):
+        self.replicas = [_FakeReplica(0), _FakeReplica(1),
+                         _FakeReplica(2, live=False)]
+        self._swap_plan = {"params": object(), "queue": [1],
+                           "current": 0, "requeued": set()}
+        self.aborts = 0
+        self.restarts = []
+
+    def abort_rolling_update(self):
+        self._swap_plan = None
+        self.aborts += 1
+        return 1
+
+    def restart_replica(self, idx):
+        self.restarts.append(idx)
+        self.replicas[idx].live = True
+
+
+def check_responder_actions() -> dict:
+    """Alert transitions drive arm/relax, restart, abort."""
+    from apex_tpu.telemetry import FleetResponder
+    from apex_tpu.telemetry.slo import SLO, SLOTracker
+
+    fleet = _FakeFleet()
+    resp = FleetResponder(fleet)
+    att = SLOTracker(SLO(name="slo_attainment", objective=0.9),
+                     lambda agg: (0.0, 0.0))
+    avail = SLOTracker(SLO(name="replica_available", objective=0.5,
+                           kind="threshold", target=0.99,
+                           higher_is_better=True),
+                       lambda agg: None)
+
+    def rec(tracker, state, prev, severity="page"):
+        return {"name": tracker.slo.name, "state": state,
+                "prev_state": prev, "severity": severity}
+
+    actions = []
+    # load alert fires -> degradation armed on live replicas + the
+    # in-flight rolling update aborted (page severity)
+    actions += resp.respond(att, rec(att, "firing", "ok"), now=1.0)
+    armed = [r.engine.admission.degradation is not None
+             for r in fleet.replicas if r.live]
+    arm_ok = all(armed) and resp.armed and fleet.aborts == 1
+    # availability fires -> dead replica restarted
+    actions += resp.respond(avail, rec(avail, "firing", "pending"),
+                            now=2.0)
+    restart_ok = fleet.restarts == [2]
+    # load alert resolves -> policies relaxed back (None here)
+    actions += resp.respond(att, rec(att, "resolved", "firing",
+                                     severity=None), now=3.0)
+    relaxed = [r.engine.admission.degradation is None
+               for r in fleet.replicas]
+    relax_ok = all(relaxed) and not resp.armed
+    kinds = sorted({a["action"] for a in actions})
+    ok = arm_ok and restart_ok and relax_ok
+    return {"ok": ok, "armed": arm_ok, "restarted": restart_ok,
+            "relaxed": relax_ok, "action_kinds": kinds,
+            "n_actions": len(actions)}
+
+
+def check_prom_exposition() -> dict:
+    """The exposition is well-formed: every line parses, summaries
+    carry _sum/_count."""
+    import re
+
+    from apex_tpu.telemetry import MetricsAggregator
+
+    agg = MetricsAggregator()
+    for i in range(40):
+        agg.record({"event": "request_end", "replica_id": i % 2,
+                    "rid": i, "status": "completed", "reason": "eos",
+                    "generated": 5, "preemptions": 0, "restarts": 0,
+                    "slo_ok": True, "ttft_ms": 10.0 + i,
+                    "latency_ms": 100.0 + i})
+        agg.record({"event": "reject", "replica_id": i % 2,
+                    "code": "queue_full"})
+    text = agg.to_prom_text()
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+        r'-?[0-9.einf]+$')
+    bad_lines = []
+    summaries = set()
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if parts[3] == "summary":
+                summaries.add(parts[2])
+            continue
+        if not line_re.match(ln):
+            bad_lines.append(ln)
+    sums_ok = all(f"{s}_sum" in text and f"{s}_count" in text
+                  for s in summaries)
+    ok = not bad_lines and sums_ok and summaries
+    return {"ok": bool(ok), "bad_lines": bad_lines[:5],
+            "summaries": sorted(summaries), "sums_ok": sums_ok}
+
+
+CHECKS = {
+    "hist_accuracy": check_hist_accuracy,
+    "merge_order": check_merge_order,
+    "aggregation_determinism": check_aggregation_determinism,
+    "burn_rate_alert": check_burn_rate_alert,
+    "responder_actions": check_responder_actions,
+    "prom_exposition": check_prom_exposition,
+}
+
+
+def run_checks(names=None) -> dict:
+    out = {"event": "fleet_status_check", "checks": {}}
+    ok = True
+    for name in (list(names) if names else sorted(CHECKS)):
+        res = CHECKS[name]()
+        out["checks"][name] = res
+        ok = ok and bool(res["ok"])
+    out["ok"] = ok
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fleet health: status table, SLO budgets, alerts")
+    ap.add_argument("jsonl", nargs="?",
+                    help="telemetry JSONL stream to fold")
+    ap.add_argument("--self", action="store_true", dest="self_check",
+                    help="run the health plane's built-in smokes")
+    ap.add_argument("--check", action="append", choices=sorted(CHECKS),
+                    help="restrict --self to specific check(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit the Prometheus text exposition")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        try:
+            result = run_checks(args.check)
+        except Exception as e:  # infra failure must not read as healthy
+            print(f"fleet status check failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            for name, res in result["checks"].items():
+                status = "PASS" if res["ok"] else "FAIL"
+                detail = {k: v for k, v in res.items()
+                          if k not in ("ok", "cases", "bad_lines")}
+                print(f"{status}  {name}  {detail}")
+            print("summary:", json.dumps({"ok": result["ok"]}))
+        return 0 if result["ok"] else 1
+
+    if not args.jsonl:
+        ap.error("nothing to do: pass a telemetry JSONL file or --self")
+    from apex_tpu.telemetry import read_jsonl
+
+    try:
+        records = read_jsonl(args.jsonl)
+    except OSError as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 2
+    agg, trackers, alerts_seen = replay_records(records)
+    if args.prom:
+        sys.stdout.write(agg.to_prom_text())
+    elif args.json:
+        print(json.dumps({
+            "replicas": {str(k): v for k, v in fleet_table(agg).items()},
+            "slos": slo_table(trackers),
+            "firing": [t.slo.name for t in trackers if t.firing],
+            "alerts_in_stream": alerts_seen,
+            "dropped_series": agg.dropped_series,
+        }, indent=2, default=str))
+    else:
+        print(render_status(agg, trackers, alerts_seen))
+    return 1 if any(t.firing for t in trackers) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
