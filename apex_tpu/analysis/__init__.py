@@ -14,8 +14,14 @@ Entry points:
   at/above a severity);
 - :func:`check_pack_spec` — standalone :class:`PackSpec` verification
   (the ROADMAP sharded-packed precondition);
+- :func:`comm_volume` — static per-program
+  ``{collective: {count, bytes, axes}}`` report (the serving psum pins
+  and compare_bench comm gates are stated in it);
+- :func:`check_shard_specs` — standalone PartitionSpec-vs-mesh
+  verification (the mesh-rebase pre-trace gate);
 - ``RULES`` — the rule registry (``donation``, ``host_sync``,
-  ``dtype_flow``, ``constants``, ``packing``, ``scopes``).
+  ``dtype_flow``, ``constants``, ``packing``, ``scopes``,
+  ``collectives``, ``sharding``).
 
 CLI: ``python tools/static_audit.py --self`` audits the repo's own
 headline steps (CI-gateable exit codes). See ``docs/static_analysis.md``.
@@ -25,6 +31,14 @@ from .auditor import (  # noqa: F401
     assert_step_clean,
     audit_step,
     trace_step,
+)
+from .collectives import (  # noqa: F401
+    CollectiveBudget,
+    CollectiveRecord,
+    check_collective_budget,
+    check_shard_specs,
+    collective_inventory,
+    comm_volume,
 )
 from .report import AuditReport, Finding, SEVERITIES  # noqa: F401
 from .rules import (  # noqa: F401
@@ -38,6 +52,8 @@ from .walk import WalkCtx, collect_consts, walk  # noqa: F401
 __all__ = [
     "AuditConfig",
     "AuditReport",
+    "CollectiveBudget",
+    "CollectiveRecord",
     "Finding",
     "RULES",
     "SEVERITIES",
@@ -45,9 +61,13 @@ __all__ = [
     "WalkCtx",
     "assert_step_clean",
     "audit_step",
+    "check_collective_budget",
     "check_pack_spec",
     "check_reshard",
+    "check_shard_specs",
     "collect_consts",
+    "collective_inventory",
+    "comm_volume",
     "trace_step",
     "walk",
 ]

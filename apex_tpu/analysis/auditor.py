@@ -190,6 +190,9 @@ def audit_step(
     compute_dtype: Optional[str] = None,
     strict_dtype: bool = False,
     shard_count: Optional[int] = None,
+    collective_budget=None,
+    replicated_bytes: int = 1 << 20,
+    loop_collective_threshold: int = 4,
 ) -> AuditReport:
     """Statically audit one training/optimizer step. See module docs.
 
@@ -198,6 +201,11 @@ def audit_step(
     dtype rule ("bfloat16"/"float16"/"float32"); ``None`` infers it from
     the step's own matmul mix. ``min_bytes`` is the noise floor: buffers
     smaller than this never produce donation/dtype findings.
+    ``collective_budget`` declares the program's communication contract
+    (a :class:`~apex_tpu.analysis.CollectiveBudget`: exact per-kind eqn
+    counts, allowed named axes, per-gather byte cap) for the
+    ``collectives`` rule; ``replicated_bytes`` is the floor above which
+    a fully replicated shard_map operand is reported by ``sharding``.
     """
     unknown = set(rules or ()) - set(RULES)
     if unknown:
@@ -215,6 +223,9 @@ def audit_step(
         compute_dtype=compute_dtype,
         strict_dtype=strict_dtype,
         shard_count=shard_count,
+        collective_budget=collective_budget,
+        replicated_bytes=replicated_bytes,
+        loop_collective_threshold=loop_collective_threshold,
     )
     selected = tuple(rules) if rules else tuple(RULES)
     findings: List[Finding] = []

@@ -63,6 +63,9 @@ class AuditConfig:
     compute_dtype: Optional[str] = None  # "bfloat16"/"float16"/"float32"/None=infer
     strict_dtype: bool = False        # fp32 matmul -> error instead of warning
     shard_count: Optional[int] = None  # PackSpec shard-alignment check
+    collective_budget: Optional[Any] = None  # CollectiveBudget for this program
+    loop_collective_threshold: int = 4  # reductions-in-one-loop-body warning
+    replicated_bytes: int = 1 << 20   # large replicated shard_map operand floor
 
 
 def _aval_bytes(aval) -> int:
@@ -633,14 +636,21 @@ def rule_packing(trace, cfg: AuditConfig) -> List[Finding]:
 # ---------------------------------------------------------------------------
 # named-scope coverage
 # ---------------------------------------------------------------------------
-def _contains_prim(jaxpr, names: Sequence[str], max_depth: int = 4) -> bool:
-    if max_depth < 0:
+def _contains_prim(jaxpr, names: Sequence[str],
+                   max_depth: Optional[int] = None) -> bool:
+    """True when any equation at any transparent nesting depth is one of
+    ``names``. Unbounded by default: the old ``max_depth=4`` cap let a
+    collective nested under cond-in-scan-in-shard_map silently escape
+    the scan-shape detection (jaxprs are finite, so the recursion always
+    terminates — a cap only ever *hides* equations)."""
+    if max_depth is not None and max_depth < 0:
         return False
+    sub_depth = None if max_depth is None else max_depth - 1
     for eqn in jaxpr.eqns:
         if eqn.primitive.name in names:
             return True
         for sub in transparent_subjaxprs(eqn):
-            if _contains_prim(sub, names, max_depth - 1):
+            if _contains_prim(sub, names, sub_depth):
                 return True
     return False
 
@@ -670,6 +680,10 @@ def rule_scopes(trace, cfg: AuditConfig) -> List[Finding]:
     return out
 
 
+# imported last: collectives.py depends on report/walk only, never on
+# this module, so the registry import below cannot cycle
+from .collectives import rule_collectives, rule_sharding  # noqa: E402
+
 RULES = {
     "donation": rule_donation,
     "host_sync": rule_host_sync,
@@ -677,4 +691,6 @@ RULES = {
     "constants": rule_constants,
     "packing": rule_packing,
     "scopes": rule_scopes,
+    "collectives": rule_collectives,
+    "sharding": rule_sharding,
 }

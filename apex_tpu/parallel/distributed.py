@@ -433,6 +433,24 @@ class DistributedDataParallel:
             concat=concat,
         )
 
+    def collective_budget(self, buckets: GradBuckets, *,
+                          extra_psums: int = 0):
+        """The declared communication contract of a step built on
+        :meth:`reduce_flat`: exactly one psum per bucket, all over this
+        DDP's axis — the quantity the PR-14 jaxpr pin asserts, now
+        spelled as a :class:`~apex_tpu.analysis.CollectiveBudget` that
+        ``analysis.audit_step(..., collective_budget=...)`` enforces
+        structurally. ``extra_psums`` accounts for reductions the step
+        adds outside the bucketed path (e.g. a pmean'd loss — pmean
+        lowers to psum + divide)."""
+        # lazy: analysis imports optimizer/packing modules; keep
+        # parallel importable without pulling that stack in
+        from ..analysis.collectives import CollectiveBudget
+
+        return CollectiveBudget(
+            counts={"psum": buckets.n_buckets + int(extra_psums)},
+            axes=(self.axis_name,))
+
     def sync(self, grads: Pytree) -> Pytree:
         if self.bucket_cap_mb:
             # pytree-in/pytree-out spelling of the bucketed path: K

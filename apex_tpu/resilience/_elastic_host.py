@@ -228,8 +228,8 @@ def main() -> int:
     # steps ahead while a peer is still importing. Best effort — a peer
     # that never shows up is the SUPERVISOR's incident to detect, not
     # ours to die on.
-    deadline = time.monotonic() + args.barrier_timeout
-    while time.monotonic() < deadline:
+    deadline = time.monotonic() + args.barrier_timeout  # det-lint: ok (startup barrier deadline, wall-domain)
+    while time.monotonic() < deadline:  # det-lint: ok (startup barrier deadline, wall-domain)
         if all(os.path.exists(os.path.join(args.heartbeat_dir,
                                            f"hb-{h}"))
                for h in range(args.world)):

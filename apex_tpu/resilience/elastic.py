@@ -75,6 +75,7 @@ from ..checkpoint import (
     stale_writer,
 )
 from ..multi_tensor_apply.packing import DEFAULT_CHUNK, ROW, PackSpec, _round_up
+from ..telemetry.recorder import stamp_wall
 from .manager import _STEP_DIR, CheckpointManager, _snapshot_leaf
 from .retry import (
     ELASTIC_BARRIER_POLICY,
@@ -215,7 +216,7 @@ class Heartbeat:
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True)
 
-    def beat(self, step: int) -> None:
+    def beat(self, step: int) -> None:  # det-lint: ok (lease beats are wall-domain by contract)
         tmp = f"{self.path}.tmp-{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"host": self.host, "step": int(step),
@@ -227,7 +228,7 @@ class Heartbeat:
         return _read_json(path)
 
     @staticmethod
-    def age_s(path: str) -> Optional[float]:
+    def age_s(path: str) -> Optional[float]:  # det-lint: ok (lease age vs file mtime, wall-domain)
         """Seconds since the last beat, or None when no beat landed."""
         try:
             return max(0.0, time.time() - os.stat(path).st_mtime)
@@ -450,7 +451,7 @@ class ElasticCheckpointManager(CheckpointManager):
             meta["data"] = state.data
         return snapshot, meta
 
-    def _write(self, step: int, snapshot: dict, meta: dict,
+    def _write(self, step: int, snapshot: dict, meta: dict,  # det-lint: ok (checkpoint span timestamps, wall-domain)
                *, lock_timeout_s: Optional[float] = None) -> None:
         t0 = time.perf_counter()
         # wall-clock start of THIS save attempt: the non-zero ranks'
@@ -696,8 +697,8 @@ class ElasticCheckpointManager(CheckpointManager):
                   "emergency": bool(meta.get("emergency")),
                   "pid": os.getpid(),  # committer liveness: the
                   #  non-zero ranks' wait rejects a corpse marker
-                  "t_wall": time.time(),
                   "format": "apex_tpu.elastic_commit.v1"}
+        stamp_wall(commit)
         marker_tmp = os.path.join(
             step_dir, f"{COMMIT_MARKER}.tmp-{os.getpid()}")
         with open(marker_tmp, "w") as f:
@@ -985,7 +986,7 @@ class Supervisor:
     def _emit(self, rec: dict) -> None:
         if self._record is not None:
             try:
-                self._record({"t_wall": time.time(), **rec})
+                self._record(stamp_wall(dict(rec)))
             except Exception:
                 pass
 
@@ -1027,7 +1028,7 @@ class Supervisor:
             mttr_s=inc.recovery_s, recovered=inc.recovery_s is not None)
 
     # -- lifecycle ---------------------------------------------------------
-    def _launch_world(self, incarnation: int) -> List[_Host]:
+    def _launch_world(self, incarnation: int) -> List[_Host]:  # det-lint: ok (supervisor MTTR spans, wall-domain)
         os.makedirs(self.heartbeat_dir, exist_ok=True)
         hosts = []
         for h in range(self.world):
@@ -1065,7 +1066,7 @@ class Supervisor:
             except Exception:
                 pass
 
-    def _find_incident(self, hosts: List[_Host],
+    def _find_incident(self, hosts: List[_Host],  # det-lint: ok (supervisor MTTR spans, wall-domain)
                        incarnation: int) -> Optional[Incident]:
         now = time.monotonic()
         for hp in hosts:
@@ -1089,7 +1090,7 @@ class Supervisor:
                     now)
         return None
 
-    def run(self) -> dict:
+    def run(self) -> dict:  # det-lint: ok (supervisor MTTR spans, wall-domain)
         """Supervise until every host exits 0. Returns the summary dict
         (also useful as the bench MTTR record)."""
         incarnation = 0

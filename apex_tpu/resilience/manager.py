@@ -62,6 +62,7 @@ from ..checkpoint import (
     save_checkpoint,
     stale_writer,
 )
+from ..telemetry.recorder import stamp_wall
 from .retry import RetryPolicy, as_record, retry_call
 from .state import TrainState, device_part, flat_leaves, unflatten_like
 
@@ -149,7 +150,7 @@ class CheckpointManager:
     def _emit(self, rec: dict) -> None:
         if self._record is not None:
             try:
-                self._record({"t_wall": time.time(), **rec})
+                self._record(stamp_wall(dict(rec)))
             except Exception:
                 pass  # telemetry must never sink a checkpoint
 

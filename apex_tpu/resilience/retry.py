@@ -88,7 +88,7 @@ class RetryPolicy:
     max_delay: float = 30.0
     deadline: Optional[float] = None
     emit_every: int = 1
-    rng: random.Random = field(default_factory=random.Random, repr=False)
+    rng: random.Random = field(default_factory=random.Random, repr=False)  # det-lint: ok (full-jitter wants per-host entropy)
 
     def is_transient(self, e: BaseException) -> bool:
         if not isinstance(e, self.retry_on):

@@ -39,6 +39,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..telemetry.recorder import stamp_wall
 from .state import TrainState, host_snapshot
 
 #: anomaly kinds (telemetry.numerics drain events) that trigger a rewind
@@ -185,11 +186,11 @@ class RewindController:
             new_data = data_iter.state()
         restored = snap.state._replace(data=new_data)
         if self._record is not None:
-            rec = {"event": "rewind", "to_step": snap.step,
+            rec = stamp_wall(
+                  {"event": "rewind", "to_step": snap.step,
                    "trigger": trigger or "manual",
                    "rewinds": self.rewinds,
-                   "snapshot_data_position": snap.data_position,
-                   "t_wall": time.time()}
+                   "snapshot_data_position": snap.data_position})
             if current_step is not None:
                 rec["step"] = int(current_step)
             if new_data is not None:

@@ -74,7 +74,7 @@ class _Armed:
     def __init__(self, what: str, timeout_s: float, context=None):
         self.what = what
         self.timeout_s = timeout_s
-        self.deadline = time.monotonic() + timeout_s
+        self.deadline = time.monotonic() + timeout_s  # det-lint: ok (hang deadline, wall-domain)
         self.tripped = False
         self.dump = ""
         self.context = context
@@ -102,7 +102,7 @@ class HangWatchdog:
     blocking point having to thread the ids through.
     """
 
-    def __init__(self, timeout_s: float = 300.0, *, sink=None,
+    def __init__(self, timeout_s: float = 300.0, *, sink=None,  # det-lint: ok (hang deadlines, wall-domain)
                  on_hang: Optional[Callable[[str, str], None]] = None,
                  poll_s: float = 0.05, context: Optional[dict] = None):
         self.timeout_s = float(timeout_s)
@@ -119,7 +119,7 @@ class HangWatchdog:
         self.trips = 0  # lifetime count of fired deadlines
 
     # -- deterministic wait (poll loop we own) -----------------------------
-    def wait(self, ready, what: str, *,
+    def wait(self, ready, what: str, *,  # det-lint: ok (hang deadlines, wall-domain)
              timeout_s: Optional[float] = None, context=None) -> None:
         """Block until ``ready`` — a ``threading.Event`` or a bool
         predicate — or raise :class:`HangError` with a stack dump at the
@@ -206,7 +206,7 @@ class HangWatchdog:
                 target=self._run, name="apex-tpu-hang-watchdog", daemon=True)
             self._monitor.start()
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # det-lint: ok (hang deadlines, wall-domain)
         while not self._stop.wait(self.poll_s):
             now = time.monotonic()
             fired = []

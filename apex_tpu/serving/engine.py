@@ -241,6 +241,7 @@ class ServingEngine:
         self.spec_local = self.spec.shard(self.tp)
         self._mesh = None
         self._psum_counts: Optional[Dict[str, int]] = None
+        self._comm_volume: Optional[Dict[str, Dict]] = None
         if self.tp > 1:
             # mechanical layout gate: the global flat pool must divide
             # into tp ROW-aligned extents (the per-shard PackSpec the
@@ -485,23 +486,43 @@ class ServingEngine:
             out_specs=(self._kv_pspec(), rep, rep),
             check_rep=False)
 
-    def program_psum_counts(self) -> Optional[Dict[str, int]]:
-        """Textual jaxpr psum count per enabled serving program (None
-        at tp=1 — there are no collectives to count). The fori_loop
-        layer body appears once, so each program counts its two
-        sublayer tails plus the sampler's one fused reduction = 3 —
-        the number the psum-pin test and ``_summarize`` report."""
+    def program_comm_volume(self) -> Optional[Dict[str, Dict]]:
+        """Static ``{program: {collective: {count, bytes, axes}}}``
+        report over every enabled serving program, from
+        :func:`apex_tpu.analysis.comm_volume` (a jaxpr walk — trace
+        only, no execution). ``None`` at tp=1: there are no collectives
+        to report. Cached: the programs are fixed at construction."""
         if self.tp == 1:
             return None
-        if self._psum_counts is None:
+        if self._comm_volume is None:
+            from ..analysis import comm_volume
+
             progs = [("decode", self.step_program())]
             if self.prefill_chunk > 1:
                 progs.append(("chunk_prefill", self.chunk_step_program()))
             if self.spec_k > 0:
                 progs.append(("spec_verify", self.spec_step_program()))
-            self._psum_counts = {
-                name: str(jax.make_jaxpr(fn)(*args)).count("psum")
+            self._comm_volume = {
+                name: comm_volume(fn, *args)
                 for name, (fn, args) in progs}
+        return self._comm_volume
+
+    def program_psum_counts(self) -> Optional[Dict[str, int]]:
+        """Walker-based psum eqn count per enabled serving program
+        (None at tp=1 — there are no collectives to count). Derived
+        from :meth:`program_comm_volume`, NOT from counting "psum" in
+        the jaxpr text (which also matches scope strings and
+        ``reduce_scatter``'s psum_scatter spelling). The fori_loop
+        layer body appears once, so each program counts its two
+        sublayer tails plus the sampler's one fused reduction = 3 —
+        the number the psum-pin test and ``_summarize`` report."""
+        vols = self.program_comm_volume()
+        if vols is None:
+            return None
+        if self._psum_counts is None:
+            self._psum_counts = {
+                name: int(v.get("psum", {}).get("count", 0))
+                for name, v in vols.items()}
         return self._psum_counts
 
     def _build_step(self):
@@ -1690,6 +1711,9 @@ class ServingEngine:
             "tp": self.tp,
             "kv_bytes_per_shard": self.spec_local.cache_bytes(),
             "psum_per_program": self.program_psum_counts(),
+            # full static comm report ({program: {collective: {count,
+            # bytes, axes}}}) — what compare_bench's comm gates read
+            "comm_volume": self.program_comm_volume(),
             # latency attribution (telemetry.spans): per-term TTFT/e2e
             # percentiles, the sum-vs-measured identity's max relative
             # error, and the dominant-cause tally over SLO violators;

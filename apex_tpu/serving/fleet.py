@@ -996,6 +996,9 @@ class ReplicaFleet:
             "psum_per_program": next(
                 (r.engine.program_psum_counts()
                  for r in self.replicas if r.live), None),
+            "comm_volume": next(
+                (r.engine.program_comm_volume()
+                 for r in self.replicas if r.live), None),
             "n_requests": len(reqs),
             "completed": len(completed),
             "by_status": by_status,

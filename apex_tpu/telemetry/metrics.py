@@ -42,6 +42,8 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from .recorder import stamp_wall
+
 Pytree = Any
 
 
@@ -235,8 +237,10 @@ def drain(
             "loss_scale": float(loss_scale),
             "overflow_skips": int(overflow_skips),
             "scale_growths": int(scale_growths),
-            "t_wall": time.time(),
         }
+        # one wall-timestamp choke point for the whole record schema
+        # (recorder.stamp_wall) — tools/lint_determinism.py enforces it
+        stamp_wall(rec)
         if tag is not None:
             rec["tag"] = tag
         if extra:

@@ -78,12 +78,13 @@ Render the JSONL stream with ``python tools/health_report.py run.jsonl``
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .recorder import stamp_wall
 
 Pytree = Any
 
@@ -444,7 +445,7 @@ class NumericsMonitor:
 
         def _emit(step, nf, sq, ma, overflow, spike, ratio, norm, ewma,
                   scale, prev_scale, clps, first_bad, stl, consec):
-            base = {"step": int(step), "t_wall": time.time()}
+            base = stamp_wall({"step": int(step)})
             if tag is not None:
                 base["tag"] = tag
             if bool(overflow):
@@ -487,18 +488,18 @@ class NumericsMonitor:
         if health_every:
             def _emit_health(step, sq, ma, nf, norm, ewma, scale,
                              first_bad):
-                rec = {"event": "numerics_health", "step": int(step),
+                rec = stamp_wall(
+                      {"event": "numerics_health", "step": int(step),
                        "grad_norm": float(norm),
                        "ewma_norm": float(ewma),
                        "loss_scale": float(scale),
                        "first_bad_step": int(first_bad),
-                       "t_wall": time.time(),
                        "leaves": {
                            names[i]: {
                                "norm": float(np.sqrt(sq[i])),
                                "maxabs": float(ma[i]),
                                "nonfinite": float(nf[i]),
-                           } for i in range(len(names))}}
+                           } for i in range(len(names))}})
                 if tag is not None:
                     rec["tag"] = tag
                 record(rec)
@@ -538,10 +539,10 @@ class ActivationWatch:
         self.tag = tag
 
     def _emit(self, name, layer, maxabs, nonfinite, norm, extra=None):
-        rec = {"event": "activation", "name": str(name),
+        rec = stamp_wall(
+              {"event": "activation", "name": str(name),
                "maxabs": float(maxabs), "nonfinite": float(nonfinite),
-               "norm": float(norm),
-               "t_wall": time.time()}
+               "norm": float(norm)})
         layer = int(layer)
         if layer >= 0:
             rec["layer"] = layer

@@ -406,12 +406,15 @@ def gpt_step_audit():
     if _gpt_step_for_breakdown is None:
         return None
     try:
-        from apex_tpu.analysis import audit_step
+        from apex_tpu.analysis import audit_step, comm_volume
 
         step_fn, state = _gpt_step_for_breakdown
         rep = audit_step(step_fn, *state, name="gpt_headline")
+        # the static comm report rides along ({} on a single-chip step;
+        # per-collective {count, bytes, axes} once the step is meshed)
         return {"ok": rep.ok, **rep.counts(),
-                "codes": sorted(set(rep.codes()))}
+                "codes": sorted(set(rep.codes())),
+                "comm_volume": comm_volume(step_fn, *state)}
     except Exception as e:  # the audit must never sink the bench
         import sys as _sys
 
@@ -1448,6 +1451,7 @@ def bench_serving_tp():
             "ttft_p99_ms": st["ttft_ms"].get("p99"),
             "kv_bytes_per_chip": eng.spec_local.cache_bytes(),
             "psum_per_program": eng.program_psum_counts(),
+            "comm_volume": eng.program_comm_volume(),
             "steps": st["steps"],
             "page_leaks": fleet.page_leaks(),
         }
@@ -1464,6 +1468,9 @@ def bench_serving_tp():
         "ttft_p99_ms": tp_arm["ttft_p99_ms"],
         "kv_bytes_per_chip": tp_arm["kv_bytes_per_chip"],
         "psum_per_program": tp_arm["psum_per_program"],
+        # static per-program comm report (trace-time, no execution) —
+        # compare_bench gates count/bytes drift per collective
+        "comm_volume": tp_arm["comm_volume"],
         "steps": tp_arm["steps"],
         "page_leaks": tp_arm["page_leaks"] + dp_arm["page_leaks"],
         # the equal-chip DP reference arm

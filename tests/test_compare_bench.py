@@ -277,3 +277,84 @@ def test_grad_lifecycle_smoke_artifact_carries_gated_legs():
     assert leg["speedup"] > 1.0
     assert leg["flat"]["final_loss"] == leg["per_leaf"]["final_loss"]
     assert leg["n_buckets"] >= 2 and leg["world"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# static comm budgets (ISSUE-19): count pins + bytes growth gate
+# ---------------------------------------------------------------------------
+def _comm(psum_count=3, psum_bytes=1040, gather_bytes=2048):
+    return {"psum": {"count": psum_count, "bytes": psum_bytes,
+                     "axes": ["tensor"]},
+            "all_gather": {"count": 2, "bytes": gather_bytes,
+                           "axes": ["tensor"]}}
+
+
+def _bench_with_comm(**kw):
+    b = _bench()
+    b["serving_tp"] = {"comm_volume": {"decode": _comm(**kw)}}
+    return b
+
+
+def test_comm_count_change_is_exact_pin_both_directions():
+    base = _bench_with_comm()
+    grew = _bench_with_comm(psum_count=4)
+    rep = compare(base, grew, threshold=0.05)
+    (entry,) = [r for r in rep["regressions"]
+                if r["leg"].startswith("comm_count:")]
+    assert entry["leg"] == "comm_count:serving_tp.decode/psum"
+    assert entry["base"] == 3 and entry["new"] == 4
+    # a VANISHED collective regresses too (lost reduction != perf win)
+    shrank = _bench_with_comm(psum_count=2)
+    rep2 = compare(base, shrank, threshold=0.05)
+    assert any(r["leg"] == "comm_count:serving_tp.decode/psum"
+               for r in rep2["regressions"])
+
+
+def test_comm_new_collective_family_is_flagged():
+    base = _bench_with_comm()
+    new = _bench_with_comm()
+    new["serving_tp"]["comm_volume"]["decode"]["ppermute"] = {
+        "count": 1, "bytes": 64, "axes": ["tensor"]}
+    rep = compare(base, new, threshold=0.05)
+    assert any(r["leg"] == "comm_count:serving_tp.decode/ppermute"
+               and r["base"] == 0 and r["new"] == 1
+               for r in rep["regressions"])
+
+
+def test_comm_bytes_growth_gated_at_threshold():
+    base = _bench_with_comm()
+    fat = _bench_with_comm(gather_bytes=4096)  # +100% at equal count
+    rep = compare(base, fat, threshold=0.05)
+    (entry,) = [r for r in rep["regressions"]
+                if r["leg"].startswith("comm_bytes:")]
+    assert entry["leg"] == "comm_bytes:serving_tp.decode/all_gather"
+    assert entry["delta_pct"] == 100.0
+    # within the threshold: unchanged
+    ok = compare(base, _bench_with_comm(gather_bytes=2080),
+                 threshold=0.05)
+    assert not any(r["leg"].startswith("comm_")
+                   for r in ok["regressions"])
+
+
+def test_comm_absent_in_either_capture_is_not_a_regression():
+    """Captures predating the comm model (or a program dropped from the
+    bench matrix) compare on the legs they share, like audit blocks."""
+    rep = compare(_bench(), _bench_with_comm(), threshold=0.05)
+    assert rep["comm"] is None
+    assert not any(r["leg"].startswith("comm_")
+                   for r in rep["regressions"])
+    rep2 = compare(_bench_with_comm(), _bench(), threshold=0.05)
+    assert rep2["comm"] is None
+
+
+def test_comm_gpt_headline_rides_audit_block():
+    base = _bench()
+    base["audit"] = {"ok": True, "error": 0, "warning": 0, "codes": [],
+                     "comm_volume": {"psum": {"count": 4, "bytes": 100,
+                                              "axes": ["data"]}}}
+    new = json.loads(json.dumps(base))
+    new["audit"]["comm_volume"]["psum"]["count"] = 5
+    rep = compare(base, new, threshold=0.05)
+    assert rep["comm"]["programs"] == ["gpt_headline"]
+    assert any(r["leg"] == "comm_count:gpt_headline/psum"
+               for r in rep["regressions"])

@@ -393,10 +393,25 @@ def test_scopes_green_packed_kernels_are_scoped():
 # ---------------------------------------------------------------------------
 def seeded_violation_report():
     """One deterministic step violating every rule family at once."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.analysis import CollectiveBudget
+
     big = np.ones((300, 1024), np.float32)  # ~1.2 MiB baked constant
+    # one-device mesh: the traced shard_map (and its psums) is identical
+    # on the 8-device harness and a standalone 1-device run
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tensor",))
 
     def unscoped_kernel(x_ref, o_ref):
         o_ref[:] = x_ref[:] * 2.0
+
+    def tp_body(a, b):
+        t = jax.lax.psum(a @ b, "tensor")
+        return jax.lax.psum(t, "tensor")  # unpaired double reduction
+
+    tp = shard_map(tp_body, mesh=mesh, in_specs=(P(), P()),
+                   out_specs=P(), check_rep=False)
 
     def step(state, x16, w16, scale):
         jax.debug.callback(lambda v: None, x16)       # ungated callback
@@ -407,7 +422,8 @@ def seeded_violation_report():
             out_shape=jax.ShapeDtypeStruct(z.shape, z.dtype),
             input_output_aliases={0: 0}, interpret=True)(z)
         out = (y.astype(jnp.float32).sum() + z.sum()
-               + jnp.asarray(big).sum()) * scale
+               + jnp.asarray(big).sum()
+               + tp(x16, w16).sum().astype(jnp.float32)) * scale
         return {"exp_avg": state["exp_avg"] * 0.9}, out  # carried, undonated
 
     args = ({"exp_avg": jnp.ones((256, 256), jnp.float32)},
@@ -419,7 +435,14 @@ def seeded_violation_report():
     corrupt = copy.copy(corrupt)
     corrupt.offsets = (0, 2100)                        # mid-row offset
     return audit_step(step, *args, name="seeded", min_bytes=1024,
-                      pack_specs=[corrupt])
+                      pack_specs=[corrupt],
+                      # budget declares ONE psum over no axes: the body's
+                      # two tensor-axis psums land over_budget + unknown
+                      collective_budget=CollectiveBudget(
+                          counts={"psum": 1}, axes=()),
+                      # the replicated bf16 GEMM operands (128 KiB each)
+                      # trip the scouting warning at this threshold
+                      replicated_bytes=1 << 16)
 
 
 def test_golden_fixture_matches():
@@ -437,7 +460,7 @@ def test_golden_fixture_covers_every_family():
     want = json.loads(GOLDEN.read_text())
     rules = {f["rule"] for f in want["findings"]}
     assert rules == {"donation", "host_sync", "dtype_flow", "constants",
-                     "packing", "scopes"}
+                     "packing", "scopes", "collectives", "sharding"}
     assert want["ok"] is False
 
 

@@ -213,6 +213,30 @@ def attribution_problems(bench: Optional[dict]) -> List[str]:
     return problems
 
 
+# legs that carry a static per-program ``comm_volume`` report
+# ({program: {collective: {count, bytes, axes}}} — see
+# apex_tpu.analysis.comm_volume); the gpt headline's report rides inside
+# the ``audit`` block
+COMM_LEGS = ("serving_tp",)
+
+
+def comm_reports(bench: Optional[dict]) -> Dict[str, dict]:
+    """Every static comm report a capture carries, flattened to
+    ``{"leg.program": {collective: {count, bytes, ...}}}``. Empty for
+    captures that predate the comm model."""
+    out: Dict[str, dict] = {}
+    for leg in COMM_LEGS:
+        cv = _dig(bench or {}, f"{leg}.comm_volume")
+        if isinstance(cv, dict):
+            for prog, colls in cv.items():
+                if isinstance(colls, dict):
+                    out[f"{leg}.{prog}"] = colls
+    cv = _dig(bench or {}, "audit.comm_volume")
+    if isinstance(cv, dict) and cv:
+        out["gpt_headline"] = cv
+    return out
+
+
 def _dig(d: dict, path: str):
     cur = d
     for part in path.split("."):
@@ -339,6 +363,37 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
             "new": False,
             "codes": an.get("codes"),
         })
+    # static comm budgets (ISSUE-19): for every program both captures
+    # report, the per-collective eqn COUNT is an exact pin (a collective
+    # appearing unbudgeted is new communication; one vanishing is a lost
+    # reduction — a numerics hazard, not a perf win), and the static
+    # BYTES may not grow past the threshold — comm regressions caught at
+    # trace time, off-TPU, before any wall-clock number moves
+    comm_report = None
+    cb, cn = comm_reports(base), comm_reports(new)
+    shared_progs = sorted(set(cb) & set(cn))
+    if shared_progs:
+        comm_report = {"programs": shared_progs}
+        for prog in shared_progs:
+            for coll in sorted(set(cb[prog]) | set(cn[prog])):
+                b_c = cb[prog].get(coll) or {}
+                n_c = cn[prog].get(coll) or {}
+                bc = int(b_c.get("count") or 0)
+                nc = int(n_c.get("count") or 0)
+                bby = int(b_c.get("bytes") or 0)
+                nby = int(n_c.get("bytes") or 0)
+                if nc != bc:
+                    regressions.append({
+                        "leg": f"comm_count:{prog}/{coll}",
+                        "base": bc, "new": nc,
+                    })
+                elif nby > bby * (1.0 + threshold):
+                    regressions.append({
+                        "leg": f"comm_bytes:{prog}/{coll}",
+                        "base": bby, "new": nby,
+                        "delta_pct": round(
+                            100.0 * (nby - bby) / bby, 2) if bby else None,
+                    })
     # attribution-summary schema (ISSUE-17): a NEW capture whose serving
     # legs carry a malformed attribution block — or one whose terms no
     # longer sum to the measured TTFT — is drift, flagged like a perf leg
@@ -356,6 +411,7 @@ def compare(base: dict, new: dict, threshold: float = 0.05) -> dict:
         "only_in_new": sorted(set(b) - set(a)),
         "audit": {"base": ab, "new": an},
         "op_categories": cat_report,
+        "comm": comm_report,
     }
 
 

@@ -1,0 +1,324 @@
+"""Host-determinism lint: keep wall clocks and unseeded RNGs out of the
+replayable planes.
+
+The VirtualClock byte-identity oracles (``tests/test_serving.py``,
+``tests/test_resilience.py``) replay serving and recovery decisions
+deterministically by injecting a virtual clock; the flight-recorder /
+JSONL record schema gets its one wall timestamp through
+``telemetry.recorder.stamp_wall``; fleet time flows through
+``fleet._read_clock``. A stray ``time.time()`` or module-level
+``random``/``np.random`` draw anywhere else in those planes silently
+re-couples them to the host, and the oracles stop proving anything.
+
+This is an AST pass (no imports of the linted code), run over
+``apex_tpu/serving``, ``apex_tpu/resilience`` and ``apex_tpu/telemetry``
+by default:
+
+- ``wall_clock``   — a direct ``time.time()`` / ``time.monotonic()``
+                     (or ``_ns`` variant) call outside the
+                     ``_read_clock`` / ``stamp_wall`` choke points;
+- ``global_rng``   — a draw from the module-level ``random`` /
+                     ``np.random`` global state (unseedable per
+                     call site, shared across the process);
+- ``unseeded_rng`` — ``random.Random()`` / ``np.random.default_rng()``
+                     / ``np.random.RandomState()`` constructed with no
+                     seed (including as a dataclass
+                     ``default_factory``).
+
+Waivers: genuinely wall-domain code (hang watchdog deadlines, lease
+files, MTTR spans) carries ``# det-lint: ok (<reason>)`` on the calling
+line, or on the ``def`` line to waive a whole function. Every waiver is
+a documented claim that the value never feeds a replayed decision.
+
+Usage::
+
+    python tools/lint_determinism.py              # text report, exit 1 on findings
+    python tools/lint_determinism.py --json
+    python tools/lint_determinism.py path/to/file.py other/dir
+
+Exit codes: 0 clean, 1 violations, 2 infra/usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = (
+    os.path.join("apex_tpu", "serving"),
+    os.path.join("apex_tpu", "resilience"),
+    os.path.join("apex_tpu", "telemetry"),
+)
+
+# the two sanctioned wall-clock choke points (module docstring)
+CHOKE_POINTS = {"_read_clock", "stamp_wall"}
+WAIVER_TOKEN = "det-lint: ok"
+
+_WALL_FUNCS = {"time", "monotonic", "time_ns", "monotonic_ns"}
+# module-level draws on the process-global random state
+_GLOBAL_DRAWS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "getrandbits", "randbytes",
+}
+_NP_DRAWS = {
+    "rand", "randn", "randint", "random", "random_sample", "uniform",
+    "choice", "shuffle", "permutation", "normal", "standard_normal",
+    "bytes", "exponential", "poisson",
+}
+_RNG_CTORS = {"Random", "default_rng", "RandomState", "SystemRandom"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str       # repo-relative
+    line: int
+    code: str       # wall_clock / global_rng / unseeded_rng
+    symbol: str     # the offending call, dotted
+    func: str       # enclosing function ("" = module level)
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Aliases:
+    """Import tracking: which local names mean the time / random /
+    numpy.random modules (or their from-imported members)."""
+
+    def __init__(self):
+        self.time_mods: Set[str] = set()     # `import time [as t]`
+        self.time_funcs: Dict[str, str] = {}  # `from time import time as t`
+        self.random_mods: Set[str] = set()   # `import random [as r]`
+        self.numpy_mods: Set[str] = set()    # `import numpy [as np]`
+        self.np_random_mods: Set[str] = set()  # `from numpy import random`
+        self.np_random_members: Dict[str, str] = {}  # from numpy.random import X
+
+    def collect(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    if a.name == "time":
+                        self.time_mods.add(local)
+                    elif a.name == "random":
+                        self.random_mods.add(local)
+                    elif a.name in ("numpy", "numpy.random"):
+                        self.numpy_mods.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                if node.module == "time":
+                    for a in node.names:
+                        if a.name in _WALL_FUNCS:
+                            self.time_funcs[a.asname or a.name] = a.name
+                elif node.module == "numpy":
+                    for a in node.names:
+                        if a.name == "random":
+                            self.np_random_mods.add(a.asname or a.name)
+                elif node.module == "numpy.random":
+                    for a in node.names:
+                        self.np_random_members[a.asname or a.name] = a.name
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, lines: Sequence[str], aliases: _Aliases):
+        self.path = path
+        self.lines = lines
+        self.al = aliases
+        self.func_stack: List[ast.AST] = []
+        self.out: List[Violation] = []
+
+    # -- helpers -----------------------------------------------------------
+    def _line_has_waiver(self, lineno: int) -> bool:
+        return (1 <= lineno <= len(self.lines)
+                and WAIVER_TOKEN in self.lines[lineno - 1])
+
+    def _waived(self, node) -> bool:
+        if self._line_has_waiver(node.lineno):
+            return True
+        return any(self._line_has_waiver(f.lineno) for f in self.func_stack)
+
+    def _enclosing(self) -> str:
+        return self.func_stack[-1].name if self.func_stack else ""
+
+    def _emit(self, node, code: str, symbol: str, message: str) -> None:
+        if self._waived(node):
+            return
+        self.out.append(Violation(
+            path=self.path, line=node.lineno, code=code, symbol=symbol,
+            func=self._enclosing(), message=message))
+
+    # -- structure ---------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- the checks --------------------------------------------------------
+    def _check_rng_ref(self, node, ref) -> bool:
+        """An unseeded-RNG constructor *reference* (e.g. passed as a
+        dataclass ``default_factory`` — called later with no args)."""
+        dotted = _dotted(ref)
+        if dotted is None:
+            return False
+        parts = dotted.split(".")
+        member = (self.al.np_random_members.get(dotted)
+                  if len(parts) == 1 else parts[-1])
+        if member not in _RNG_CTORS:
+            return False
+        head = parts[0]
+        is_rng_mod = (
+            len(parts) == 1  # `from numpy.random import default_rng`
+            or (len(parts) == 2 and (head in self.al.random_mods
+                                     or head in self.al.np_random_mods))
+            or (len(parts) == 3 and head in self.al.numpy_mods
+                and parts[1] == "random"))
+        if is_rng_mod:
+            self._emit(
+                node, "unseeded_rng", dotted,
+                f"{dotted} used as a zero-arg factory builds an "
+                "OS-entropy-seeded RNG — pass a seeded factory, or waive "
+                "with a reason if the draw is genuinely wall-domain")
+            return True
+        return False
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+        # default_factory=random.Random style references
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                self._check_rng_ref(node, kw.value)
+        if dotted is not None:
+            parts = dotted.split(".")
+            head, tail = parts[0], parts[-1]
+            in_choke = self._enclosing() in CHOKE_POINTS
+
+            # wall clock: time.time()/time.monotonic() (+_ns, + aliases)
+            is_wall = (
+                (len(parts) == 2 and head in self.al.time_mods
+                 and tail in _WALL_FUNCS)
+                or (len(parts) == 1 and dotted in self.al.time_funcs))
+            if is_wall and not in_choke:
+                self._emit(
+                    node, "wall_clock", dotted,
+                    f"direct {dotted}() outside the _read_clock/"
+                    "stamp_wall choke points — inject the clock (or "
+                    "stamp via telemetry.stamp_wall) so VirtualClock "
+                    "replays stay byte-identical")
+
+            # process-global RNG draws
+            if (len(parts) == 2 and head in self.al.random_mods
+                    and tail in _GLOBAL_DRAWS):
+                self._emit(
+                    node, "global_rng", dotted,
+                    f"{dotted}() draws from the process-global RNG — "
+                    "use an explicitly seeded random.Random (or a jax "
+                    "PRNGKey) owned by the caller")
+            elif ((len(parts) == 3 and head in self.al.numpy_mods
+                   and parts[1] == "random" and tail in _NP_DRAWS)
+                  or (len(parts) == 2 and head in self.al.np_random_mods
+                      and tail in _NP_DRAWS)
+                  or (len(parts) == 1
+                      and self.al.np_random_members.get(dotted)
+                      in _NP_DRAWS)):
+                self._emit(
+                    node, "global_rng", dotted,
+                    f"{dotted}() draws from numpy's process-global RNG "
+                    "— use np.random.default_rng(seed)")
+
+            # unseeded RNG constructors: Random()/default_rng() with no
+            # seed argument at all
+            if not node.args and not node.keywords:
+                self._check_rng_ref(node, node.func)
+        self.generic_visit(node)
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Violation]:
+    """Lint one file's source text; ``path`` labels the findings."""
+    tree = ast.parse(src, filename=path)
+    aliases = _Aliases()
+    aliases.collect(tree)
+    v = _Visitor(path, src.splitlines(), aliases)
+    v.visit(tree)
+    return sorted(v.out, key=lambda x: (x.path, x.line, x.code))
+
+
+def lint_file(path: str, rel_to: str = REPO_ROOT) -> List[Violation]:
+    with open(path) as f:
+        src = f.read()
+    rel = os.path.relpath(os.path.abspath(path), rel_to)
+    return lint_source(src, rel)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, _dirnames, filenames in os.walk(p):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def lint_paths(paths: Optional[Sequence[str]] = None) -> List[Violation]:
+    """Lint files/directories (default: the three determinism-critical
+    packages, resolved against the repo root)."""
+    if not paths:
+        paths = [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    found: List[Violation] = []
+    for f in iter_py_files(list(paths)):
+        found.extend(lint_file(f))
+    return sorted(found, key=lambda x: (x.path, x.line, x.code))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AST lint: wall clocks / unseeded RNGs outside the "
+                    "determinism choke points")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: apex_tpu/"
+                         "serving, resilience, telemetry)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        violations = lint_paths(args.paths or None)
+    except (OSError, SyntaxError) as e:
+        print(f"lint failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"ok": not violations,
+                          "violations": [v.to_dict() for v in violations]},
+                         indent=2))
+    else:
+        for v in violations:
+            where = f" in {v.func}()" if v.func else ""
+            print(f"{v.path}:{v.line}: [{v.code}] {v.symbol}{where} — "
+                  f"{v.message}")
+        print(f"{len(violations)} violation(s)"
+              if violations else "clean — no violations")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

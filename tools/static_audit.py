@@ -23,7 +23,16 @@ this tool self-hosts it on the steps the performance story depends on:
                        vocab-parallel sampler), donation and callback
                        gating intact through the wrapper;
 - ``telemetry_drain``  the in-jit metrics accumulate + cond-gated async
-                       drain path.
+                       drain path;
+- ``tp_serving_comm``  the tp_step program again, audited against its
+                       declared ``CollectiveBudget`` (the 3-psum pin,
+                       the closed ``tensor`` axis set, and a per-gather
+                       byte cap — the "no pool-scale gather" invariant,
+                       machine-checked);
+- ``ddp_comm``         the ddp_step program audited against the
+                       bucketed-sync budget: exactly ``n_buckets``
+                       psums for gradients plus one for the pmean'd
+                       loss, all over the ``data`` axis.
 
 Usage::
 
@@ -296,6 +305,47 @@ def build_telemetry_drain():
     return jitted, (telemetry.init_metrics(), jnp.float32(0)), {}
 
 
+def build_tp_serving_comm():
+    """The tp_step program under its declared communication contract
+    (ISSUE-19): the decode program may contain exactly 3 psums (attn
+    row-GEMM tail, MLP row-GEMM tail, vocab-parallel sampler), 2
+    all_gathers and one pmax/pmin pair (the sampler's cross-shard
+    argmax plumbing), all over the ``tensor`` axis only, and no single
+    gather may materialize >= 1 MiB (the pool-scale-gather ban from
+    ISSUE-16, previously only a grep over the jaxpr text). At tp=1 the
+    same program must contain NO collectives at all."""
+    fn, args, kw = build_tp_step()
+
+    from apex_tpu.analysis import CollectiveBudget
+
+    if (kw.get("shard_count") or 1) > 1:
+        budget = CollectiveBudget(
+            counts={"psum": 3, "all_gather": 2, "pmax": 1, "pmin": 1},
+            axes=("tensor",), max_gather_bytes=1 << 20)
+    else:
+        budget = CollectiveBudget(counts={}, axes=())
+    return fn, args, dict(kw, collective_budget=budget)
+
+
+def build_ddp_comm():
+    """The ddp_step program under the bucketed gradient-sync budget:
+    exactly ``n_buckets`` psums for the flat gradient buffers plus one
+    for the pmean'd loss (pmean lowers to psum + divide), every one of
+    them over the ``data`` axis — the machine form of the PR-14
+    psum-count==n_buckets jaxpr pin."""
+    fn, args, kw = build_ddp_step()
+
+    from apex_tpu.parallel import DistributedDataParallel, GradBuckets
+
+    buckets = GradBuckets(args[0], bucket_cap_mb=0.5)
+    ddp = DistributedDataParallel(axis_name="data",
+                                  gradient_average=False,
+                                  bucket_cap_mb=0.5)
+    # +1: the pmean'd loss rides the same axis outside the buckets
+    budget = ddp.collective_budget(buckets, extra_psums=1)
+    return fn, args, dict(kw, collective_budget=budget)
+
+
 TARGETS = {
     "gpt_step": build_gpt_step,
     "fused_block_step": build_fused_block_step,
@@ -304,6 +354,8 @@ TARGETS = {
     "ddp_step": build_ddp_step,
     "tp_step": build_tp_step,
     "telemetry_drain": build_telemetry_drain,
+    "tp_serving_comm": build_tp_serving_comm,
+    "ddp_comm": build_ddp_comm,
 }
 
 
