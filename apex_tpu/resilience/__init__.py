@@ -45,6 +45,7 @@ from .chaos import (  # noqa: F401
     ChaosMonkey,
     ServingChaos,
     StallingSink,
+    WorkerChaos,
     corrupt_checkpoint,
     poison_grads,
     request_storm,
@@ -62,6 +63,12 @@ from .elastic import (  # noqa: F401
     sharded_leaf_indices,
     world_chunk_size,
 )
+from .liveness import (  # noqa: F401
+    live_beat,
+    read_json_tolerant,
+    sweep_stale,
+    writer_alive,
+)
 from .manager import (  # noqa: F401
     CHECKPOINT_IO_POLICY,
     CheckpointManager,
@@ -70,6 +77,7 @@ from .manager import (  # noqa: F401
 from .retry import (  # noqa: F401
     ELASTIC_BARRIER_POLICY,
     TRANSIENT_COMPILE_POLICY,
+    TRANSPORT_POLICY,
     BarrierNotReady,
     RetryPolicy,
     retry_call,
@@ -95,16 +103,18 @@ from .watchdog import (  # noqa: F401
 __all__ = [
     "CHECKPOINT_IO_POLICY", "CheckpointManager", "PreemptionError",
     "ELASTIC_BARRIER_POLICY", "TRANSIENT_COMPILE_POLICY",
+    "TRANSPORT_POLICY",
     "BarrierNotReady", "RetryPolicy", "retry_call",
     "RewindController", "RewindExhaustedError",
     "IndexedBatches", "ResumableIterator", "TrainState", "capture",
     "host_snapshot", "resume_or_init",
     "HangError", "HangWatchdog", "dump_all_stacks",
     "ChaosError", "ChaosHost", "ChaosMonkey", "ServingChaos",
-    "StallingSink", "corrupt_checkpoint", "poison_grads",
+    "StallingSink", "WorkerChaos", "corrupt_checkpoint", "poison_grads",
     "request_storm", "send_preemption",
     "COMMIT_MARKER", "ElasticCheckpointManager", "Heartbeat",
     "Supervisor", "WorldFailedError", "grad_buckets_for_world",
     "pack_spec_for_world", "reflatten_flat", "sharded_leaf_indices",
     "world_chunk_size",
+    "live_beat", "read_json_tolerant", "sweep_stale", "writer_alive",
 ]

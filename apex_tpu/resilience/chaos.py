@@ -217,6 +217,7 @@ class ServingChaos:
         self._wedge: Dict[int, float] = {}
         self._fail_alloc = 0
         self._cache_evict = 0
+        self._worker: Dict[int, "WorkerChaos"] = {}  # replica -> faults
         self.faults_fired: list = []
 
     # -- poisoned logits ---------------------------------------------------
@@ -313,6 +314,53 @@ class ServingChaos:
             self.faults_fired.append(("alloc", None))
             return True
         return False
+
+    # -- worker-process faults (real-process fleet, ISSUE-20) --------------
+    def _worker_chaos(self, replica_id: int) -> "WorkerChaos":
+        return self._worker.setdefault(int(replica_id), WorkerChaos())
+
+    def kill_worker_at(self, replica_id: int, step: int, *,
+                       mid_frame: bool = False) -> "ServingChaos":
+        """SIGKILL replica ``replica_id``'s WORKER SUBPROCESS at its
+        ``step``-th transport step — the real-process twin of
+        :meth:`kill_replica_at` (a raised exception vs an actual
+        corpse: exit code, torn pipes, stale heartbeat left behind).
+        ``mid_frame=True`` kills halfway through writing the response
+        frame AND a telemetry line, so the router's frame reader and
+        ``read_jsonl`` both face a genuinely torn tail."""
+        self._worker_chaos(replica_id).kill_at(step, mid_frame=mid_frame)
+        return self
+
+    def wedge_worker_at(self, replica_id: int, step: int,
+                        stall_s: float = 30.0) -> "ServingChaos":
+        """Replica ``replica_id``'s worker stops heartbeating and
+        stalls ``stall_s`` seconds at its ``step``-th transport step
+        (bounded, so an un-watched run cannot hang forever) — the
+        supervisor's staleness detector must declare it hung, SIGKILL
+        it and restart."""
+        self._worker_chaos(replica_id).wedge_at(step, stall_s)
+        return self
+
+    def drop_frames_at(self, replica_id: int, step: int,
+                       n: int = 1) -> "ServingChaos":
+        """Replica ``replica_id``'s worker silently drops its next
+        ``n`` response frames starting at its ``step``-th transport
+        step — the lossy-transport fault: the router's RPC deadline
+        must fire and the supervisor must treat the worker as gone
+        (at-most-once stepping means an unacknowledged step cannot be
+        retried blind)."""
+        self._worker_chaos(replica_id).drop_at(step, n)
+        return self
+
+    def worker_spec(self, replica_id: int) -> str:
+        """The :class:`WorkerChaos` spec string to arm replica
+        ``replica_id``'s worker subprocess with (empty = unarmed) —
+        the supervisor passes it through argv/env, the worker parses
+        it back (:meth:`WorkerChaos.parse`). Restarted incarnations
+        are launched unarmed (the supervisor passes the spec only at
+        incarnation 0), so a revived worker does not re-die."""
+        wc = self._worker.get(int(replica_id))
+        return wc.to_spec() if wc is not None else ""
 
     # -- prefix-cache eviction under pressure ------------------------------
     def evict_prefix_cache(self, n: int) -> "ServingChaos":
@@ -474,6 +522,131 @@ class ChaosHost:
             self.faults_fired.append(("wedge", int(step)))
             return stall
         return None
+
+
+class WorkerChaos:
+    """Transport-level faults for ONE serving worker subprocess
+    (``apex_tpu.serving.worker`` — the real-process fleet's replica
+    host). Where :class:`ServingChaos` raises exceptions an in-process
+    fleet catches, this one breaks the PROCESS and its pipes, the
+    failures the :class:`~apex_tpu.serving.proc_fleet.FleetSupervisor`
+    must detect from outside:
+
+    - :meth:`kill_at` — SIGKILL self at a transport step boundary
+      (exit code + EOF on the pipes + a corpse heartbeat left behind);
+      ``mid_frame=True`` dies halfway through the response frame and
+      a telemetry line — the torn-tail case the frame reader and
+      ``read_jsonl`` must count, not crash on.
+    - :meth:`wedge_at` — stop heartbeating and stall (bounded); the
+      supervisor's staleness detector must fire.
+    - :meth:`drop_at` — swallow the next ``n`` response frames; the
+      router's RPC deadline must fire.
+
+    Faults fire once; crossing the armed step also fires (a worker
+    that restarts past the armed step does not dodge its fault — and
+    a restarted incarnation is launched unarmed anyway). Armed sets
+    serialize through :meth:`to_spec` / :meth:`parse`
+    (``"kill@6"`` / ``"killmid@6"`` / ``"wedge@9:30"`` /
+    ``"drop@5:2"``) so the supervisor arms a child worker through its
+    argv — the :class:`ChaosHost` pattern."""
+
+    def __init__(self):
+        self._kill: Optional[tuple] = None   # (step, mid_frame)
+        self._wedge: Optional[tuple] = None  # (step, stall_s)
+        self._drop: Optional[tuple] = None   # (step, n)
+        self.faults_fired: list = []
+
+    # -- arming ------------------------------------------------------------
+    def kill_at(self, step: int, *, mid_frame: bool = False
+                ) -> "WorkerChaos":
+        self._kill = (int(step), bool(mid_frame))
+        return self
+
+    def wedge_at(self, step: int, stall_s: float = 30.0) -> "WorkerChaos":
+        self._wedge = (int(step), float(stall_s))
+        return self
+
+    def drop_at(self, step: int, n: int = 1) -> "WorkerChaos":
+        self._drop = (int(step), int(n))
+        return self
+
+    @property
+    def armed(self) -> bool:
+        return (self._kill is not None or self._wedge is not None
+                or self._drop is not None)
+
+    # -- spec round-trip (supervisor -> child worker) ----------------------
+    def to_spec(self) -> str:
+        parts = []
+        if self._kill is not None:
+            step, mid = self._kill
+            parts.append(f"killmid@{step}" if mid else f"kill@{step}")
+        if self._wedge is not None:
+            parts.append(f"wedge@{self._wedge[0]}:{self._wedge[1]}")
+        if self._drop is not None:
+            parts.append(f"drop@{self._drop[0]}:{self._drop[1]}")
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, spec: str) -> "WorkerChaos":
+        out = cls()
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, arg = part.partition("@")
+            if kind in ("kill", "killmid"):
+                out.kill_at(int(arg), mid_frame=kind == "killmid")
+            elif kind == "wedge":
+                step, _, stall = arg.partition(":")
+                out.wedge_at(int(step),
+                             float(stall) if stall else 30.0)
+            elif kind == "drop":
+                step, _, n = arg.partition(":")
+                out.drop_at(int(step), int(n) if n else 1)
+            else:
+                raise ValueError(f"unknown worker chaos fault {part!r} "
+                                 f"(spec {spec!r})")
+        return out
+
+    # -- hooks (consulted by the worker's transport loop) ------------------
+    def take_kill(self, step: int) -> Optional[bool]:
+        """``mid_frame`` flag when the kill fires at ``step`` (crossing
+        the armed step fires too), else ``None``. The CALLER dies —
+        mid-frame kills must first emit their torn bytes, so the kill
+        itself cannot live here."""
+        if self._kill is not None and int(step) >= self._kill[0]:
+            _, mid = self._kill
+            self._kill = None
+            self.faults_fired.append(
+                ("kill_worker", int(step), bool(mid)))
+            return bool(mid)
+        return None
+
+    def take_wedge(self, step: int) -> Optional[float]:
+        """Stall seconds to sleep WITHOUT heartbeating, or ``None``."""
+        if self._wedge is not None and int(step) >= self._wedge[0]:
+            _, stall = self._wedge
+            self._wedge = None
+            self.faults_fired.append(("wedge_worker", int(step)))
+            return stall
+        return None
+
+    def take_drop(self, step: int) -> bool:
+        """True when THIS step's response frame should be swallowed
+        (the ``n`` budget drains one frame per step)."""
+        if self._drop is not None and int(step) >= self._drop[0]:
+            at, n = self._drop
+            self._drop = (at, n - 1) if n > 1 else None
+            self.faults_fired.append(("drop_frame", int(step)))
+            return True
+        return False
+
+    @staticmethod
+    def die() -> None:
+        """SIGKILL self: no handlers, no atexit, pipes torn as-is."""
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # unreachable; belt for exotic platforms
 
 
 def request_storm(engine, seed: int = 0) -> List[tuple]:

@@ -202,38 +202,12 @@ def sharded_leaf_indices(flat: Dict[str, object], total: int,
 
 
 # ---------------------------------------------------------------------------
-# heartbeats (the supervisor's liveness signal)
+# heartbeats (the supervisor's liveness signal) — factored into
+# resilience.liveness (ISSUE-20) so the real-process serving fleet
+# shares the exact machinery; re-exported here for the historical
+# import path (beat-file format unchanged, pinned by round-trip test).
 # ---------------------------------------------------------------------------
-class Heartbeat:
-    """A per-host liveness file: one small JSON record, atomically
-    replaced on every beat. The supervisor reads the file's mtime for
-    staleness (monotonic enough across local processes) and the content
-    for attribution (host, step, pid)."""
-
-    def __init__(self, path: str, host: int):
-        self.path = str(path)
-        self.host = int(host)
-        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
-                    exist_ok=True)
-
-    def beat(self, step: int) -> None:  # det-lint: ok (lease beats are wall-domain by contract)
-        tmp = f"{self.path}.tmp-{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"host": self.host, "step": int(step),
-                       "pid": os.getpid(), "t_wall": time.time()}, f)
-        os.replace(tmp, self.path)
-
-    @staticmethod
-    def read(path: str) -> Optional[dict]:
-        return _read_json(path)
-
-    @staticmethod
-    def age_s(path: str) -> Optional[float]:  # det-lint: ok (lease age vs file mtime, wall-domain)
-        """Seconds since the last beat, or None when no beat landed."""
-        try:
-            return max(0.0, time.time() - os.stat(path).st_mtime)
-        except OSError:
-            return None
+from .liveness import Heartbeat  # noqa: E402,F401  (re-export)
 
 
 # ---------------------------------------------------------------------------

@@ -139,6 +139,30 @@ ELASTIC_BARRIER_POLICY = RetryPolicy(
 )
 
 
+#: Router->worker transport I/O for the real-process serving fleet
+#: (``serving.proc_fleet``): every connect/reconnect and framed RPC
+#: routes through this policy, so a worker restart mid-request reads
+#: as ONE slow RPC, not an exception — the retry loop spans the
+#: SIGKILL, the relaunch and the startup rendezvous. ``retry_on=
+#: (OSError,)`` covers the whole transport failure surface (broken
+#: pipes, connection resets, and ``serving.transport``'s
+#: ``WorkerUnavailable``, an OSError subclass); full-jitter backoff
+#: avoids re-stampeding a restarting worker, and the wall-clock
+#: ``deadline`` — not the attempt count — is the contract: past it the
+#: worker is declared dead and the supervisor's migration path owns
+#: the request. Per-attempt ``{"event": "retry"}`` records ride the
+#: fleet sink (``emit_every`` keeps a normal restart from flooding
+#: the stream).
+TRANSPORT_POLICY = RetryPolicy(
+    attempts=10_000,
+    retry_on=(OSError,),
+    base_delay=0.05,
+    max_delay=1.0,
+    deadline=30.0,
+    emit_every=5,
+)
+
+
 def retry_call(
     fn: Callable,
     *,

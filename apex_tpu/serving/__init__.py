@@ -39,7 +39,14 @@ training stack's own machinery:
   behind a deadline-aware router (feasibility x load over each
   replica's EWMA step-time cost model), drain/join rolling weight
   swaps with zero dropped requests, and replica-kill migration riding
-  the replay carrier (requests-lost = 0, token-identical survivors).
+  the replay carrier (requests-lost = 0, token-identical survivors);
+- :mod:`~apex_tpu.serving.proc_fleet` /
+  :mod:`~apex_tpu.serving.worker` /
+  :mod:`~apex_tpu.serving.transport` — the REAL-process fleet (opt-in;
+  the in-process fleet above stays the default): one ``ServingEngine``
+  per supervised worker subprocess, crash-safe length-prefixed framing
+  with torn-frame accounting, heartbeat liveness, SIGKILL + restart +
+  zero-loss migration under :class:`FleetSupervisor`.
 
 ``tools/serving_check.py --self`` is the CI smoke; ``docs/serving.md``
 the design document; ``bench.py``'s ``serving_throughput`` /
@@ -72,6 +79,19 @@ from .fleet import (  # noqa: F401
     Replica,
     ReplicaFleet,
     ReplicaState,
+)
+from .proc_fleet import (  # noqa: F401
+    FleetSupervisor,
+)
+from .transport import (  # noqa: F401
+    Channel,
+    FrameReader,
+    TransportError,
+    WorkerUnavailable,
+    read_frames,
+    request_from_wire,
+    request_to_wire,
+    write_frame,
 )
 from .kv_cache import (  # noqa: F401
     KVCacheState,
@@ -106,7 +126,10 @@ from .scheduler import (  # noqa: F401
 __all__ = [
     "AdmissionConfig",
     "AdmissionController",
+    "Channel",
     "DegradationPolicy",
+    "FleetSupervisor",
+    "FrameReader",
     "GREEDY",
     "SamplingParams",
     "KVCacheState",
@@ -130,7 +153,9 @@ __all__ = [
     "SlotState",
     "TERMINAL_STATES",
     "TransientRequestFailure",
+    "TransportError",
     "VirtualClock",
+    "WorkerUnavailable",
     "chunk_hidden",
     "decode_tokens",
     "default_page_size",
@@ -138,11 +163,15 @@ __all__ = [
     "ngram_propose",
     "page_table_row",
     "prefill_chunk_tokens",
+    "read_frames",
     "recover_requests",
     "reference_decode",
     "reference_sample_decode",
+    "request_from_wire",
+    "request_to_wire",
     "run_spec_step",
     "sample_tokens",
     "write_chunk_kv",
+    "write_frame",
     "write_token_kv",
 ]

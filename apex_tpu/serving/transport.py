@@ -1,0 +1,273 @@
+"""Crash-safe framing for the real-process serving fleet.
+
+The router (:class:`~apex_tpu.serving.proc_fleet.FleetSupervisor`) and
+its worker subprocesses (:mod:`apex_tpu.serving.worker`) speak typed
+request/response records over plain OS pipes. The wire format is
+**length-prefixed newline-JSON**::
+
+    <decimal payload length>\\n<payload JSON>\\n
+
+chosen for the same reasons the telemetry plane uses JSONL: it is
+greppable mid-incident (``strings`` on a pipe dump reads fine), the
+length prefix makes message boundaries explicit (no quadratic scan for
+a closing brace, binary-safe payloads later), and the trailing newline
+is a per-frame checksum-of-convenience — a frame whose declared length
+does not land on a newline was torn or corrupted.
+
+Crash semantics mirror :func:`apex_tpu.telemetry.read_jsonl`'s
+post-mortem contract, because the failure is the same one: a SIGKILLed
+writer dies mid-``write`` and leaves a truncated FINAL frame. The
+reader counts it (:attr:`FrameReader.torn_frames`) and treats it as
+end-of-stream instead of crashing — the supervisor's job at that point
+is failover, not parsing. Corruption anywhere *before* EOF (a complete
+frame that fails its own framing) is a different failure — the stream
+is not what the writer wrote — and raises :class:`TransportError`.
+
+Every frame is emitted as ONE ``os.write`` of the complete encoding
+(:func:`write_frame`), so a reader never observes a half frame from a
+*live* writer; only death tears.
+
+:class:`WorkerUnavailable` — raised on timeouts and peer EOF — is an
+``OSError`` subclass on purpose: the router routes every RPC through
+:data:`apex_tpu.resilience.retry.TRANSPORT_POLICY` (``retry_on=
+(OSError,)``), so a worker restart mid-request reads as one slow RPC.
+"""
+from __future__ import annotations
+
+import json
+import os
+import select
+import time
+from typing import List, Optional
+
+__all__ = [
+    "Channel",
+    "FrameReader",
+    "TransportError",
+    "WorkerUnavailable",
+    "frame_bytes",
+    "read_frames",
+    "request_from_wire",
+    "request_to_wire",
+    "write_frame",
+]
+
+#: refuse frames larger than this — a corrupted length prefix must not
+#: turn into an unbounded buffer allocation
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+
+class TransportError(RuntimeError):
+    """The stream is not a valid frame sequence (mid-stream corruption:
+    non-numeric length prefix, payload not JSON, missing trailing
+    newline). NOT transient — nobody retries a corrupted stream."""
+
+
+class WorkerUnavailable(OSError):
+    """The peer is gone or not answering (EOF, closed pipe, RPC
+    deadline). An ``OSError`` so :data:`~apex_tpu.resilience.retry.
+    TRANSPORT_POLICY` treats it as transient: the supervisor's restart
+    may bring the worker back before the policy's wall-clock deadline."""
+
+
+def frame_bytes(obj) -> bytes:
+    """Encode one frame: ``b"<len>\\n<payload>\\n"``."""
+    payload = json.dumps(obj).encode()
+    return str(len(payload)).encode() + b"\n" + payload + b"\n"
+
+
+def write_frame(fd: int, obj) -> None:
+    """Emit ``obj`` as one frame with ONE ``os.write`` — the atomicity
+    unit a live writer guarantees. (A signal-interrupted partial write
+    is completed in a follow-up loop; only a *dead* writer tears.)"""
+    data = frame_bytes(obj)
+    try:
+        n = os.write(fd, data)
+        while n < len(data):  # EINTR partial on a huge frame
+            n += os.write(fd, data[n:])
+    except (BrokenPipeError, ValueError) as e:  # peer died / fd closed
+        raise WorkerUnavailable(f"peer gone mid-write: {e}") from e
+
+
+class FrameReader:
+    """Incremental frame parser over a pipe/file descriptor.
+
+    :meth:`read_frame` returns the next payload dict, or ``None`` at
+    end-of-stream. A truncated final frame (the writer was SIGKILLed
+    mid-write) is counted in :attr:`torn_frames` and folded into
+    end-of-stream; a complete-but-invalid frame raises
+    :class:`TransportError`; a ``timeout`` with no frame raises
+    :class:`WorkerUnavailable`.
+    """
+
+    def __init__(self, fd: int):
+        self.fd = int(fd)
+        self._buf = bytearray()
+        self._eof = False
+        self.torn_frames = 0
+        self.frames_read = 0
+
+    def _parse(self) -> Optional[dict]:
+        """One complete frame from the buffer, or None if more bytes
+        are needed. Raises TransportError on framing violations."""
+        nl = self._buf.find(b"\n")
+        if nl < 0:
+            if len(self._buf) > 32:  # no sane length prefix is longer
+                raise TransportError(
+                    f"unterminated length prefix: {bytes(self._buf[:32])!r}")
+            return None
+        header = bytes(self._buf[:nl])
+        if not header.isdigit():
+            raise TransportError(f"bad length prefix {header!r}")
+        n = int(header)
+        if n > MAX_FRAME_BYTES:
+            raise TransportError(f"frame of {n} bytes exceeds cap "
+                                 f"{MAX_FRAME_BYTES}")
+        end = nl + 1 + n
+        if len(self._buf) < end + 1:  # payload + trailing newline
+            return None
+        if self._buf[end:end + 1] != b"\n":
+            raise TransportError("frame missing trailing newline "
+                                 "(length prefix and payload disagree)")
+        payload = bytes(self._buf[nl + 1:end])
+        del self._buf[:end + 1]
+        try:
+            obj = json.loads(payload)
+        except ValueError as e:
+            raise TransportError(f"frame payload not JSON: {e}") from e
+        self.frames_read += 1
+        return obj
+
+    def read_frame(self, timeout: Optional[float] = None,
+                   *, clock=time.monotonic) -> Optional[dict]:  # det-lint: ok (RPC deadline is wall-domain)
+        deadline = None if timeout is None else clock() + float(timeout)
+        while True:
+            got = self._parse()
+            if got is not None:
+                return got
+            if self._eof:
+                if self._buf:
+                    # torn final frame: the writer died mid-write —
+                    # count it, drop it, fold into end-of-stream
+                    self.torn_frames += 1
+                    self._buf.clear()
+                return None
+            if deadline is not None:
+                remaining = deadline - clock()
+                if remaining <= 0:
+                    raise WorkerUnavailable(
+                        f"no frame within {timeout:.2f}s")
+                r, _, _ = select.select([self.fd], [], [], remaining)
+                if not r:
+                    continue  # re-check the deadline
+            try:
+                chunk = os.read(self.fd, 65536)
+            except OSError as e:
+                raise WorkerUnavailable(f"read failed: {e}") from e
+            if not chunk:
+                self._eof = True
+            else:
+                self._buf += chunk
+
+
+def read_frames(path: str, *, stats: Optional[dict] = None) -> List[dict]:
+    """Post-mortem: parse a FILE of frames (e.g. a worker's response
+    log) with :func:`~apex_tpu.telemetry.read_jsonl` semantics — a torn
+    final frame is skipped (counted in ``stats["torn_frames"]``),
+    mid-file corruption raises :class:`TransportError`."""
+    with open(path, "rb") as f:
+        reader = FrameReader(f.fileno())
+        out = []
+        while True:
+            rec = reader.read_frame()
+            if rec is None:
+                break
+            out.append(rec)
+    if stats is not None:
+        stats["torn_frames"] = (stats.get("torn_frames", 0)
+                                + reader.torn_frames)
+    return out
+
+
+class Channel:
+    """One duplex router<->worker link: framed writes down ``wfd``,
+    framed reads (with deadlines) up from ``rfd``."""
+
+    def __init__(self, wfd: int, rfd: int):
+        self.wfd = int(wfd)
+        self.reader = FrameReader(rfd)
+
+    @property
+    def torn_frames(self) -> int:
+        return self.reader.torn_frames
+
+    def send(self, obj) -> None:
+        write_frame(self.wfd, obj)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        return self.reader.read_frame(timeout)
+
+    def rpc(self, obj, timeout: Optional[float] = None) -> dict:
+        """Send one record, demand one reply. EOF (worker death — torn
+        or clean) surfaces as :class:`WorkerUnavailable`, never as a
+        silent ``None``: an RPC caller always expected an answer."""
+        self.send(obj)
+        reply = self.recv(timeout)
+        if reply is None:
+            raise WorkerUnavailable("worker EOF before reply")
+        return reply
+
+
+# -- Request <-> wire ------------------------------------------------------
+# The submit-side subset of serving.scheduler.Request, JSON-safe. The
+# supervisor serializes budgets ALREADY REBASED to remaining wall-clock
+# (a migrated request must honor its ORIGINAL deadline, and the worker's
+# clock starts at admission); out_tokens ride along so a migrant replays
+# prompt+generated on the new worker — the recompute-replay carrier.
+
+def request_to_wire(req) -> dict:
+    wire = {
+        "rid": int(req.rid),
+        "prompt": [int(t) for t in req.prompt],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_id": None if req.eos_id is None else int(req.eos_id),
+        "arrival_step": int(req.arrival_step),
+        "priority": int(req.priority),
+        "ttft_budget_ms": req.ttft_budget_ms,
+        "latency_budget_ms": req.latency_budget_ms,
+        "out_tokens": [int(t) for t in req.out_tokens],
+        "restarts": int(req.restarts),
+        "retries": int(req.retries),
+        "labels": req.labels,
+    }
+    if req.sampling is not None:
+        s = req.sampling
+        wire["sampling"] = {"temperature": s.temperature,
+                            "top_k": s.top_k, "top_p": s.top_p,
+                            "seed": s.seed}
+    return wire
+
+
+def request_from_wire(wire: dict):
+    from .sampling import SamplingParams
+    from .scheduler import Request
+
+    sampling = None
+    if wire.get("sampling") is not None:
+        sampling = SamplingParams(**wire["sampling"])
+    req = Request(
+        prompt=list(wire["prompt"]),
+        max_new_tokens=int(wire["max_new_tokens"]),
+        eos_id=wire.get("eos_id"),
+        arrival_step=int(wire.get("arrival_step", 0)),
+        priority=int(wire.get("priority", 0)),
+        ttft_budget_ms=wire.get("ttft_budget_ms"),
+        latency_budget_ms=wire.get("latency_budget_ms"),
+        sampling=sampling,
+        rid=int(wire["rid"]),
+        labels=wire.get("labels"),
+    )
+    req.out_tokens = [int(t) for t in wire.get("out_tokens", [])]
+    req.restarts = int(wire.get("restarts", 0))
+    req.retries = int(wire.get("retries", 0))
+    return req

@@ -140,12 +140,19 @@ class RingBufferRecorder(NullRecorder):
 class JsonlRecorder(NullRecorder):
     """Append-only JSONL file sink, one record per line.
 
-    Writes are flushed per record (drain cadence is the batching knob —
-    see :func:`apex_tpu.telemetry.drain`'s ``every_n``), and guarded by a
-    lock: async ``jax.debug.callback`` emissions may land from a runtime
-    thread. Only the logging process writes (``only_logging_process``,
-    default True — the MLPerf/Megatron rank-0 convention); other ranks
-    construct the recorder fine and silently drop records.
+    Multi-PROCESS safe by construction, not by lock: the file is opened
+    ``O_APPEND`` and every record goes out as ONE ``os.write`` of a
+    complete line, so concurrent per-replica writers (the real-process
+    serving fleet runs one recorder per worker subprocess against one
+    shared stream) can never interleave partial lines — POSIX appends
+    each ``write`` atomically at end-of-file. A buffered file handle
+    would silently break this: ``BufferedWriter`` splits writes larger
+    than its buffer, and the torn halves interleave. The threading lock
+    still guards in-process concurrency (async ``jax.debug.callback``
+    emissions land from a runtime thread) and the close race. Only the
+    logging process writes (``only_logging_process``, default True —
+    the MLPerf/Megatron rank-0 convention); other ranks construct the
+    recorder fine and silently drop records.
     """
 
     def __init__(self, path, *, only_logging_process: bool = True,
@@ -154,37 +161,37 @@ class JsonlRecorder(NullRecorder):
         self._lock = threading.Lock()
         self._enabled = (not only_logging_process
                          or is_logging_process(log_rank))
-        self._fh = None
+        self._fd: Optional[int] = None
         if self._enabled:
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
-            self._fh = open(self.path, "a" if append else "w")
+            flags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+            if not append:
+                flags |= os.O_TRUNC
+            self._fd = os.open(self.path, flags, 0o644)
 
     def record(self, rec: dict) -> None:
-        if self._fh is None:
+        if self._fd is None:
             return
         rec = stamp_wall({k: _jsonable(v) for k, v in rec.items()})
-        line = json.dumps(rec)
+        data = (json.dumps(rec) + "\n").encode()
         with self._lock:
-            if self._fh is None:  # closed between check and write
+            if self._fd is None:  # closed between check and write
                 return
-            self._fh.write(line + "\n")
-            self._fh.flush()
+            os.write(self._fd, data)  # ONE write: the atomicity unit
 
     def add_scalar(self, name, value, step) -> None:
         self.record({"event": "scalar", "name": str(name),
                      "value": _jsonable(value), "step": _jsonable(step)})
 
     def flush(self) -> None:
-        with self._lock:
-            if self._fh is not None:
-                self._fh.flush()
+        pass  # os.write is unbuffered; nothing to drain
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
 
 class TaggedRecorder(NullRecorder):

@@ -2119,6 +2119,116 @@ def bench_elastic_mttr():
         _sh.rmtree(run_dir, ignore_errors=True)
 
 
+def bench_serving_proc_fleet():
+    """``serving_proc_fleet`` leg (ISSUE-20): zero-loss failover of the
+    REAL-process serving fleet under the full chaos bar.
+
+    ``BENCH_PROC_FLEET_REPLICAS`` worker SUBPROCESSES (one
+    ``ServingEngine`` each, tiny model — the subject is the supervision
+    plane, not the forward pass) serve ``BENCH_PROC_FLEET_REQUESTS``
+    requests while chaos SIGKILLs replica 1 mid-reply-frame AND wedges
+    replica 2's heartbeat in the SAME run. The supervisor must detect
+    death by exit code and hang by beat staleness, SIGKILL + restart
+    both, and migrate their in-flight work over the replay carrier.
+
+    Reported costs: ``mttr_s`` (incident detect -> restarted worker's
+    ready frame, the worst of the two incidents), ``goodput`` (tokens
+    from requests that met their deadline / wall), ``slo_attainment``,
+    and the hard gates ``requests_lost`` (compare_bench pins it to 0
+    absolutely) and token identity vs the dense reference. Budgets are
+    generous multiples of a calibrated per-request wall so SLO misses
+    mean supervision stalls, not model speed."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from apex_tpu.resilience import ServingChaos
+    from apex_tpu.serving import (
+        FleetSupervisor, Request, RequestStatus, reference_decode,
+    )
+    from apex_tpu.serving.worker import model_from_spec
+
+    replicas = int(os.environ.get("BENCH_PROC_FLEET_REPLICAS", "3"))
+    n_requests = int(os.environ.get("BENCH_PROC_FLEET_REQUESTS", "10"))
+    max_new = 6
+
+    spec = {"kind": "tiny_gpt",
+            "engine": {"n_slots": 2, "num_pages": 8,
+                       "max_prompt_len": 16}}
+    cfg, params = model_from_spec(spec)
+    rng = np.random.default_rng(20)
+    prompts = [list(rng.integers(0, cfg.vocab_size,
+                                 size=int(rng.integers(7, 14))))
+               for _ in range(n_requests)]
+
+    # calibrate: one undisturbed single-worker pass prices a request's
+    # wall (jit + RPC + decode) so chaos-run budgets are meaningful
+    wd0 = tempfile.mkdtemp(prefix="bench-proc-cal-")
+    t0 = _time.monotonic()
+    with FleetSupervisor(spec, 1, workdir=wd0,
+                         heartbeat_timeout_s=2.0, rpc_timeout_s=6.0,
+                         startup_timeout_s=240.0) as cal:
+        cal.launch()
+        cal.generate([Request(prompt=prompts[0], max_new_tokens=max_new,
+                              arrival_step=0)], max_steps=500)
+    cal_s = max(_time.monotonic() - t0, 0.5)
+    # a migrated request eats detection (heartbeat_timeout) + restart
+    # (a full jax startup + jit) before its replay finishes; budget for
+    # that, not for the undisturbed path
+    budget_ms = (cal_s + 300.0) * 1000.0
+
+    reqs = [Request(prompt=p, max_new_tokens=max_new, arrival_step=i,
+                    latency_budget_ms=budget_ms)
+            for i, p in enumerate(prompts)]
+    chaos = ServingChaos().kill_worker_at(1, 4, mid_frame=True)
+    if replicas >= 3:
+        chaos.wedge_worker_at(2, 6, stall_s=60.0)
+    wd = tempfile.mkdtemp(prefix="bench-proc-fleet-")
+    t0 = _time.monotonic()
+    with FleetSupervisor(spec, replicas, workdir=wd, chaos=chaos,
+                         heartbeat_timeout_s=2.0, rpc_timeout_s=6.0,
+                         startup_timeout_s=240.0) as sup:
+        sup.launch()
+        out = sup.generate(reqs, max_steps=4000)
+        st = sup.last_stats
+        leaks = sup.page_leaks()
+    wall_s = _time.monotonic() - t0
+
+    mismatched = sum(
+        1 for r in reqs
+        if out[r.rid] != reference_decode(cfg, params, r.prompt,
+                                          r.max_new_tokens))
+    if mismatched:
+        raise RuntimeError(
+            f"serving_proc_fleet: {mismatched} requests diverged from "
+            "the dense reference — refusing to publish")
+    if any(r.status is not RequestStatus.COMPLETED for r in reqs):
+        raise RuntimeError(
+            "serving_proc_fleet: not every request completed — "
+            "refusing to publish")
+    return {"serving_proc_fleet": {
+        "replicas": replicas,
+        "n_requests": n_requests,
+        "requests_lost": st["requests_lost"],
+        "migrated": st["migrated"],
+        "replica_deaths": st["replica_deaths"],
+        "incidents": sorted(i["kind"] for i in st["incidents"]),
+        "mttr_s": st["mttr_s"],
+        "mttr_mean_s": st["mttr_mean_s"],
+        "torn_frames": st["torn_frames"],
+        "slo_attainment": st["slo_attainment"],
+        "goodput_tokens_per_sec": st["goodput_tokens_per_sec"],
+        "tokens_per_sec": st["tokens_per_sec"],
+        "by_status": st["by_status"],
+        "latency_budget_ms": round(budget_ms, 1),
+        "calibration_s": round(cal_s, 2),
+        "page_leaks": leaks,
+        "wall_s": round(wall_s, 2),
+        "backend": jax.default_backend(),
+    }}
+
+
 def bench_fp8_gemm(iters=20, m=8192, k=4096, n=4096):
     """fp8 (e4m3, delayed scaling) vs bf16 GEMM at one large shape — the
     chip-measured datapoint for the fp8 groundwork. On chips without a
@@ -2702,6 +2812,24 @@ def main() -> None:
             print(f"elastic mttr bench failed: "
                   f"{type(e).__name__}: {e}", file=_sys.stderr)
 
+    # serving_proc_fleet leg: the ISSUE-20 real-process fleet — worker
+    # subprocess SIGKILL + wedge with zero-loss migration. Spawns real
+    # jax worker processes, so fast mode skips it unless
+    # BENCH_PROC_FLEET=1 forces it (the CPU smoke configuration;
+    # artifact committed under bench_artifacts/). BENCH_PROC_FLEET=0
+    # skips everywhere.
+    serving_proc_fleet = None
+    want_proc = os.environ.get("BENCH_PROC_FLEET")
+    if want_proc != "0" and (not fast or want_proc == "1"):
+        try:
+            serving_proc_fleet = _retry_transient(
+                bench_serving_proc_fleet, tag="serving proc fleet leg")
+        except Exception as e:  # must not sink the bench
+            import sys as _sys
+
+            print(f"serving proc fleet bench failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+
     fp8_ratio = None
     fp8_model = None
     if not fast:
@@ -2779,6 +2907,8 @@ def main() -> None:
         "spec_decode": (spec_decode or {}).get("spec_decode"),
         "grad_lifecycle": (grad_lifecycle or {}).get("grad_lifecycle"),
         "elastic_mttr": (elastic_mttr or {}).get("elastic_mttr"),
+        "serving_proc_fleet": (serving_proc_fleet
+                               or {}).get("serving_proc_fleet"),
         "fp8_e4m3_gemm_vs_bf16": fp8_ratio,
         "gpt2_345m_fp8": fp8_model,
         "op_breakdown": op_breakdown,

@@ -93,6 +93,18 @@ METRICS: Tuple[Tuple[str, str, bool], ...] = (
     ("elastic_mttr_s", "elastic_mttr.mttr_s", False),
     ("elastic_save_overhead_pct",
      "elastic_mttr.save_overhead_pct", False),
+    # ISSUE-20 real-process fleet: zero-loss failover across actual
+    # SIGKILLed worker subprocesses — requests_lost is gated absolutely
+    # at 0 (one lost request is a regression), MTTR covers subprocess
+    # relaunch + jax import + engine rebuild, goodput/attainment must
+    # not regress under the injected death+hang
+    ("proc_fleet_requests_lost", "serving_proc_fleet.requests_lost",
+     False),
+    ("proc_fleet_mttr_s", "serving_proc_fleet.mttr_s", False),
+    ("proc_fleet_goodput",
+     "serving_proc_fleet.goodput_tokens_per_sec", True),
+    ("proc_fleet_slo_attainment",
+     "serving_proc_fleet.slo_attainment", True),
 )
 
 # legs whose expected value is ~0, where a relative threshold would turn
@@ -112,6 +124,11 @@ ABS_TOLERANCE = {
     # swings with host load — gate drift, not noise
     "elastic_mttr_s": 5.0,  # seconds (docs/resilience.md elastic)
     "elastic_save_overhead_pct": 12.0,  # percentage points
+    # the process fleet's zero-loss contract, same shape as
+    # fleet_requests_lost; MTTR = SIGKILL detect -> restarted worker's
+    # ready frame, dominated by interpreter+jax startup on CPU
+    "proc_fleet_requests_lost": 0.5,  # requests (docs/serving.md)
+    "proc_fleet_mttr_s": 10.0,  # seconds (subprocess relaunch noise)
     # detection is denominated in fleet steps and the expected value is
     # a couple dozen; a relative threshold over a small base would flag
     # single-boundary jitter in when the window fills

@@ -47,6 +47,9 @@ Usage::
     python tools/fleet_status.py run.jsonl              # health table
     python tools/fleet_status.py run.jsonl --prom       # exposition
     python tools/fleet_status.py run.jsonl --json
+    python tools/fleet_status.py workdir/               # per-replica
+                      # JSONL directory (real-process fleet), merged
+                      # fleet-wide by t_wall; torn tails tolerated
     python tools/fleet_status.py --self [--check NAME] [--json]
 
 Exit codes (CI contract, same as serving_check/static_audit): 0 = all
@@ -69,6 +72,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # ---------------------------------------------------------------------------
 # JSONL replay -> aggregator + SLO evaluation
+
+
+def load_stream(path: str) -> list:
+    """Records from one JSONL file — or from a DIRECTORY of them,
+    merged by ``t_wall``: the real-process fleet writes one file per
+    replica incarnation (``replica-<i>.<inc>.jsonl``), and a
+    post-mortem wants the interleaved fleet-wide stream. Torn final
+    lines (SIGKILLed writers) are skipped per file, exactly like the
+    single-file path; records without a timestamp keep their per-file
+    order and sort before stamped ones (stable sort on t_wall=-inf)."""
+    from apex_tpu.telemetry import read_jsonl
+
+    if not os.path.isdir(path):
+        return read_jsonl(path)
+    records = []
+    for name in sorted(os.listdir(path)):
+        if not name.endswith(".jsonl"):
+            continue
+        records.extend(read_jsonl(os.path.join(path, name)))
+    records.sort(key=lambda r: (
+        float(r["t_wall"]) if isinstance(r.get("t_wall"), (int, float))
+        else float("-inf")))
+    return records
 
 
 def replay_records(records, *, slos=None, eval_every: int = 16):
@@ -601,11 +627,10 @@ def main(argv=None) -> int:
         return 0 if result["ok"] else 1
 
     if not args.jsonl:
-        ap.error("nothing to do: pass a telemetry JSONL file or --self")
-    from apex_tpu.telemetry import read_jsonl
-
+        ap.error("nothing to do: pass a telemetry JSONL file/directory "
+                 "or --self")
     try:
-        records = read_jsonl(args.jsonl)
+        records = load_stream(args.jsonl)
     except OSError as e:
         print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
         return 2

@@ -84,6 +84,21 @@ must hold the zero-loss contract under replica outages):
                           zero dropped requests, and post-update
                           traffic decodes per the NEW weights.
 
+Real-process fleet leg (``serving.proc_fleet`` — ISSUE-20: the same
+zero-loss contract against replicas that actually DIE):
+
+- ``proc_fleet_failover`` 3 worker SUBPROCESSES (one ServingEngine
+                          each, framed pipe transport + heartbeat
+                          files): one is SIGKILLed MID-FRAME and
+                          another's heartbeat wedged in the same run —
+                          the FleetSupervisor detects death (exit) and
+                          hang (staleness), restarts both, migrates
+                          their in-flight work on the replay carrier:
+                          requests_lost == 0, every token
+                          byte-identical to the dense reference, torn
+                          reply frame + torn telemetry line counted,
+                          zero page leaks.
+
 Tensor-parallel leg (ISSUE-16 — the identity oracle over the TP
 sharding):
 
@@ -731,6 +746,82 @@ def check_tp_identity() -> dict:
             "psum_per_program": psums, "psum_budget_ok": psum_ok}
 
 
+def check_proc_fleet_failover() -> dict:
+    """The real-process chaos bar (ISSUE-20): 3 worker SUBPROCESSES,
+    one SIGKILLed mid-frame and another wedged (heartbeat stalled) in
+    the SAME run — the FleetSupervisor must detect both (death by exit,
+    hang by staleness), SIGKILL + restart them, and migrate their
+    in-flight work: every offered request reaches exactly one terminal
+    state, requests_lost == 0, survivor AND migrant tokens
+    byte-identical to the undisturbed dense reference, zero page leaks,
+    and the torn reply frame + torn telemetry line are COUNTED, never
+    crashed on."""
+    import tempfile
+
+    import numpy as np
+
+    from apex_tpu.resilience import ServingChaos
+    from apex_tpu.serving import (
+        FleetSupervisor, Request, RequestStatus, reference_decode,
+    )
+    from apex_tpu.serving.worker import model_from_spec
+    from apex_tpu.telemetry import read_jsonl
+
+    spec = {"kind": "tiny_gpt",
+            "engine": {"n_slots": 2, "num_pages": 8,
+                       "max_prompt_len": 16}}
+    cfg, params = model_from_spec(spec)
+    rng = np.random.default_rng(11)
+    reqs = [
+        Request(prompt=list(rng.integers(0, cfg.vocab_size,
+                                         size=int(rng.integers(7, 14)))),
+                max_new_tokens=6, arrival_step=i)
+        for i in range(8)
+    ]
+    chaos = (ServingChaos()
+             .kill_worker_at(1, 4, mid_frame=True)
+             .wedge_worker_at(2, 6, stall_s=60.0))
+    wd = tempfile.mkdtemp(prefix="serving-proc-")
+    with FleetSupervisor(spec, 3, workdir=wd, chaos=chaos,
+                         heartbeat_timeout_s=2.0, rpc_timeout_s=6.0,
+                         startup_timeout_s=240.0) as sup:
+        sup.launch()
+        out = sup.generate(reqs, max_steps=2000)
+        st = sup.last_stats
+        leaks = sup.page_leaks()
+    kinds = sorted(i["kind"] for i in st["incidents"])
+    mismatches = []
+    for r in reqs:
+        ref = reference_decode(cfg, params, r.prompt, r.max_new_tokens)
+        if out[r.rid] != ref:
+            mismatches.append({"rid": r.rid, "worker": out[r.rid],
+                               "reference": ref})
+    # the killed worker's torn telemetry line must read back tolerantly
+    import glob
+
+    telem_stats = {}
+    telem_records = 0
+    for path in sorted(glob.glob(os.path.join(wd, "replica-*.jsonl"))):
+        telem_records += len(read_jsonl(path, stats=telem_stats))
+    ok = (kinds == ["worker_death", "worker_hang"]
+          and st["requests_lost"] == 0
+          and st["migrated"] >= 1
+          and st["replica_deaths"] == 2
+          and st["mttr_s"] is not None
+          and st["torn_frames"] >= 1
+          and not mismatches
+          and all(r.status is RequestStatus.COMPLETED for r in reqs)
+          and leaks == 0
+          and telem_records > 0
+          and telem_stats.get("torn_lines", 0) >= 1)
+    return {"ok": ok, "incidents": kinds,
+            "requests_lost": st["requests_lost"],
+            "migrated": st["migrated"], "mttr_s": st["mttr_s"],
+            "torn_frames": st["torn_frames"],
+            "torn_telemetry_lines": telem_stats.get("torn_lines", 0),
+            "mismatches": mismatches, "page_leaks": leaks}
+
+
 CHECKS = {
     "decode_parity": check_decode_parity,
     "tp_identity": check_tp_identity,
@@ -740,6 +831,7 @@ CHECKS = {
     "sampled_seeded_identity": check_sampled_seeded_identity,
     "fleet_kill_migrate": check_fleet_kill_migrate,
     "fleet_drain_join": check_fleet_drain_join,
+    "proc_fleet_failover": check_proc_fleet_failover,
     "token_identity": check_token_identity,
     "step_audit": check_step_audit,
     "poison_quarantine": check_poison_quarantine,
